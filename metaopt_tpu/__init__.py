@@ -1,0 +1,43 @@
+"""metaopt-tpu: a TPU-native asynchronous hyperparameter-optimization framework.
+
+Re-designed from scratch with the capability surface of ``bouthilx/metaopt``
+(the Orion precursor; see SURVEY.md) but built TPU-first on JAX/XLA:
+
+- a typed search :mod:`~metaopt_tpu.space` with the ``name~prior(...)`` DSL,
+- an asynchronous trial :mod:`~metaopt_tpu.ledger` (the MongoDB-as-bus design is
+  replaced by a single-writer coordinator + pluggable ledger backends),
+- pluggable :mod:`~metaopt_tpu.algo` (random, TPE with jit/vmap surrogate math,
+  Hyperband, ASHA, EvolutionES),
+- :mod:`~metaopt_tpu.executor` that gang-schedules trials onto TPU chips or
+  ICI-contiguous sub-slices,
+- a pod :mod:`~metaopt_tpu.coord` coordinator with heartbeats and
+  snapshot/replay resume,
+- a ``hunt``-style :mod:`~metaopt_tpu.cli` and a one-function
+  :mod:`~metaopt_tpu.client` (``report_results``) for user scripts,
+- a demo :mod:`~metaopt_tpu.models` zoo (MLP, ResNet, Transformer, PPO) sharded
+  with ``jax.sharding`` over sub-slice meshes (:mod:`~metaopt_tpu.parallel`),
+  with Pallas kernels in :mod:`~metaopt_tpu.ops` for hot paths.
+
+Reference capability contract: /root/repo/BASELINE.json; blueprint: SURVEY.md.
+(The reference mount was empty at build time — expected reference paths cited in
+docstrings follow SURVEY.md's expected-path convention, e.g.
+``ref: src/metaopt/algo/space.py`` means "the equivalent lives there in the
+public lineage"; they are design targets, not verified line cites.)
+"""
+
+__version__ = "0.1.0"
+
+from metaopt_tpu.space import Space, Real, Integer, Categorical, Fidelity
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.client import report_results
+
+__all__ = [
+    "Space",
+    "Real",
+    "Integer",
+    "Categorical",
+    "Fidelity",
+    "Trial",
+    "report_results",
+    "__version__",
+]

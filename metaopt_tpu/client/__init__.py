@@ -1,0 +1,98 @@
+"""The in-script client: the single touchpoint inside a user's training code.
+
+ref: src/metaopt/client/__init__.py — ``report_results(list_of_dicts)`` writes
+JSON to a results path injected by the trial executor (SURVEY.md §2.6: this
+file handshake IS the worker↔trial protocol; no socket, no RPC). Preserved
+verbatim, with the path injected via the ``METAOPT_TPU_RESULTS_PATH`` env var.
+
+Additions for multi-fidelity runs: ``report_partial(objective, step)`` streams
+intermediate objectives (appends JSON lines to a sidecar file) so the
+coordinator's ``judge``/early-stop hook can prune running trials, and
+``get_trial_info()`` exposes the trial's id/params/fidelity/assigned chips to
+the script.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+RESULTS_PATH_ENV = "METAOPT_TPU_RESULTS_PATH"
+TRIAL_INFO_ENV = "METAOPT_TPU_TRIAL_INFO"
+
+IS_ORCHESTRATED = RESULTS_PATH_ENV in os.environ
+
+
+class ReportError(RuntimeError):
+    pass
+
+
+def _results_path() -> str:
+    path = os.environ.get(RESULTS_PATH_ENV)
+    if not path:
+        raise ReportError(
+            f"{RESULTS_PATH_ENV} is not set — this process was not launched by "
+            "a metaopt-tpu executor. Guard the call with "
+            "`if metaopt_tpu.client.IS_ORCHESTRATED:` for standalone runs."
+        )
+    return path
+
+
+def report_results(data: List[Mapping[str, Any]]) -> None:
+    """Report final trial results. Each item:
+
+    ``{"name": ..., "type": "objective" | "constraint" | "gradient" | "statistic",
+       "value": ...}``
+
+    Exactly one ``objective`` entry is required (the scalar being minimized).
+    """
+    data = [dict(d) for d in data]
+    n_obj = sum(1 for d in data if d.get("type") == "objective")
+    if n_obj != 1:
+        raise ReportError(
+            f"report_results needs exactly one objective entry, got {n_obj}"
+        )
+    for d in data:
+        if not {"name", "type", "value"} <= set(d):
+            raise ReportError(f"malformed result entry {d!r}")
+    path = _results_path()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)  # atomic: the executor never sees a torn file
+
+
+def report_objective(value: float, name: str = "objective") -> None:
+    """Shorthand for the common single-scalar case."""
+    report_results([{"name": name, "type": "objective", "value": float(value)}])
+
+
+def report_partial(objective: float, step: int) -> None:
+    """Stream an intermediate objective (for early stopping / rung judging).
+
+    Appends a JSON line to ``<results path>.partial``; the executor polls it
+    and feeds ``algo.judge()``.
+    """
+    path = _results_path() + ".partial"
+    with open(path, "a") as f:
+        f.write(json.dumps({"objective": float(objective), "step": int(step)}) + "\n")
+        f.flush()
+
+
+def get_trial_info() -> Optional[Dict[str, Any]]:
+    """Trial id / params / fidelity / assigned chips, or None standalone."""
+    raw = os.environ.get(TRIAL_INFO_ENV)
+    return json.loads(raw) if raw else None
+
+
+__all__ = [
+    "report_results",
+    "report_objective",
+    "report_partial",
+    "get_trial_info",
+    "IS_ORCHESTRATED",
+    "RESULTS_PATH_ENV",
+    "TRIAL_INFO_ENV",
+    "ReportError",
+]

@@ -1,0 +1,15 @@
+"""Config IO: file converters and layered config resolution.
+
+ref: src/metaopt/core/io/ (resolve_config.py, converters.py).
+"""
+
+from metaopt_tpu.io.converters import Converter, JSONConverter, YAMLConverter, infer_converter
+from metaopt_tpu.io.resolve_config import resolve_config
+
+__all__ = [
+    "Converter",
+    "JSONConverter",
+    "YAMLConverter",
+    "infer_converter",
+    "resolve_config",
+]

@@ -1,0 +1,60 @@
+"""Read/write user-script config files so priors can live in config templates.
+
+ref: src/metaopt/core/io/converters.py — the lineage supports YAML/JSON (and a
+generic fallback) so that ``~prior`` expressions can be written inside the
+user's own config file; the Consumer rewrites that file with concrete values
+for each trial.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+import yaml
+
+
+class Converter:
+    """File-format adapter: parse to a (possibly nested) dict and dump back."""
+
+    extensions: tuple[str, ...] = ()
+
+    def parse(self, path: str) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def generate(self, path: str, data: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class JSONConverter(Converter):
+    extensions = (".json",)
+
+    def parse(self, path: str) -> Dict[str, Any]:
+        with open(path) as f:
+            return json.load(f)
+
+    def generate(self, path: str, data: Dict[str, Any]) -> None:
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2)
+
+
+class YAMLConverter(Converter):
+    extensions = (".yml", ".yaml")
+
+    def parse(self, path: str) -> Dict[str, Any]:
+        with open(path) as f:
+            return yaml.safe_load(f) or {}
+
+    def generate(self, path: str, data: Dict[str, Any]) -> None:
+        with open(path, "w") as f:
+            yaml.safe_dump(data, f, default_flow_style=False)
+
+
+def infer_converter(path: str) -> Converter:
+    ext = os.path.splitext(path)[1].lower()
+    for cls in (JSONConverter, YAMLConverter):
+        if ext in cls.extensions:
+            return cls()
+    # default to YAML, the lineage's lingua franca
+    return YAMLConverter()

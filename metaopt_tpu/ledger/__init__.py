@@ -1,0 +1,36 @@
+"""The asynchronous trial ledger — the framework's coordination substrate.
+
+ref: src/metaopt/core/io/database/ + src/metaopt/core/worker/{trial,experiment}.py.
+The reference coordinates stateless workers through MongoDB: atomic
+``find_one_and_update`` realizes trial reservation, unique indexes realize
+identity (SURVEY.md §2.7). Here the same contract — register / reserve (CAS) /
+update / fetch — is a small :class:`LedgerBackend` ABC with three
+implementations:
+
+- :class:`MemoryLedger` — in-process dict + lock (the EphemeralDB equivalent,
+  used by unit tests and single-process runs),
+- :class:`FileLedger` — a directory of JSON trial docs with ``flock``-based
+  CAS, giving multi-process workers on one host the same races-are-safe
+  semantics the reference gets from Mongo,
+- the coordinator-served ledger (:mod:`metaopt_tpu.coord`) for pod-scale runs.
+"""
+
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.ledger.backends import (
+    DuplicateTrialError,
+    FileLedger,
+    LedgerBackend,
+    MemoryLedger,
+    ledger_registry,
+)
+from metaopt_tpu.ledger.experiment import Experiment
+
+__all__ = [
+    "Trial",
+    "LedgerBackend",
+    "MemoryLedger",
+    "FileLedger",
+    "DuplicateTrialError",
+    "Experiment",
+    "ledger_registry",
+]

@@ -1,0 +1,403 @@
+"""Ledger backends: the storage/concurrency contract behind experiments.
+
+ref: src/metaopt/core/io/database/ — ``AbstractDB`` with CRUD + atomic
+``read_and_write``; MongoDB realizes reservation with ``find_one_and_update``
+and identity with unique indexes (SURVEY.md §2.4, §2.7). The contract kept
+here:
+
+- **register is create-if-absent** (duplicate id → ``DuplicateTrialError``,
+  the CAS-failure signal Producer uses to drop lost suggestion races),
+- **reserve is an atomic status CAS** ``new → reserved`` — exactly one worker
+  wins a trial,
+- **update_trial supports compare-and-swap on status** so a worker that lost
+  its reservation (e.g. declared stale and re-issued) cannot clobber state.
+
+Backends: in-memory (tests / single process), file+flock (multi-process on a
+host — the local stand-in for multi-worker runs), and the coordinator RPC
+client (:mod:`metaopt_tpu.coord.client_backend`) registered under ``"coord"``.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import threading
+import time
+import urllib.parse
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional
+
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.utils.registry import Registry
+
+ledger_registry: Registry = Registry("ledger backend")
+
+
+class DuplicateTrialError(RuntimeError):
+    """Raised when registering a trial whose id already exists (lost race)."""
+
+
+class DuplicateExperimentError(RuntimeError):
+    """Raised when two creators race on the same experiment name."""
+
+
+class LedgerBackend(ABC):
+    """Storage + concurrency contract. All methods are atomic per call."""
+
+    # -- experiment documents --------------------------------------------
+    @abstractmethod
+    def create_experiment(self, config: Dict[str, Any]) -> None:
+        """Create the experiment doc; raise DuplicateExperimentError if present."""
+
+    @abstractmethod
+    def load_experiment(self, name: str) -> Optional[Dict[str, Any]]: ...
+
+    @abstractmethod
+    def update_experiment(self, name: str, patch: Dict[str, Any]) -> None: ...
+
+    @abstractmethod
+    def list_experiments(self) -> List[str]: ...
+
+    # -- trials -----------------------------------------------------------
+    @abstractmethod
+    def register(self, trial: Trial) -> None:
+        """Insert a new trial; raise DuplicateTrialError on id collision."""
+
+    @abstractmethod
+    def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
+        """Atomically flip one ``new`` trial to ``reserved`` for ``worker``."""
+
+    @abstractmethod
+    def update_trial(
+        self,
+        trial: Trial,
+        expected_status: Optional[str] = None,
+        expected_worker: Optional[str] = None,
+    ) -> bool:
+        """Write back a trial doc. With ``expected_status``/``expected_worker``,
+
+        only if the stored fields match (CAS); returns False on CAS failure.
+        ``expected_worker`` guards the ABA case where a stale reservation was
+        released and re-issued to another worker — the old owner's write must
+        not clobber the new owner's state.
+        """
+
+    @abstractmethod
+    def heartbeat(self, experiment: str, trial_id: str, worker: str) -> bool:
+        """Refresh the reservation heartbeat; False if no longer ours."""
+
+    @abstractmethod
+    def get(self, experiment: str, trial_id: str) -> Optional[Trial]: ...
+
+    @abstractmethod
+    def fetch(
+        self, experiment: str, status: Optional[str | tuple] = None
+    ) -> List[Trial]: ...
+
+    def count(self, experiment: str, status: Optional[str | tuple] = None) -> int:
+        return len(self.fetch(experiment, status))
+
+    def release_stale(self, experiment: str, timeout_s: float) -> List[Trial]:
+        """Re-free reserved trials whose heartbeat lapsed (dead worker).
+
+        The v0-era reference leaks these forever (SURVEY.md §2.7 failure
+        semantics); the lineage later added a pacemaker. Here it is part of
+        the backend contract.
+        """
+        now = time.time()
+        released = []
+        for t in self.fetch(experiment, "reserved"):
+            if t.heartbeat is not None and now - t.heartbeat > timeout_s:
+                stale_owner = t.worker
+                t.status = "new"
+                t.worker = None
+                t.start_time = None
+                t.heartbeat = None
+                if self.update_trial(
+                    t, expected_status="reserved", expected_worker=stale_owner
+                ):
+                    released.append(t)
+        return released
+
+
+# ---------------------------------------------------------------------------
+
+
+@ledger_registry.register("memory")
+class MemoryLedger(LedgerBackend):
+    """Dict + lock. The EphemeralDB equivalent for tests/single-process runs."""
+
+    def __init__(self, **_: Any) -> None:
+        self._lock = threading.RLock()
+        self._experiments: Dict[str, Dict[str, Any]] = {}
+        self._trials: Dict[str, Dict[str, Trial]] = {}
+
+    def create_experiment(self, config: Dict[str, Any]) -> None:
+        name = config["name"]
+        with self._lock:
+            if name in self._experiments:
+                raise DuplicateExperimentError(name)
+            self._experiments[name] = dict(config)
+            self._trials.setdefault(name, {})
+
+    def load_experiment(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            doc = self._experiments.get(name)
+            return dict(doc) if doc else None
+
+    def update_experiment(self, name: str, patch: Dict[str, Any]) -> None:
+        with self._lock:
+            if name not in self._experiments:
+                raise KeyError(name)
+            self._experiments[name].update(patch)
+
+    def list_experiments(self) -> List[str]:
+        with self._lock:
+            return sorted(self._experiments)
+
+    def register(self, trial: Trial) -> None:
+        with self._lock:
+            exp = self._trials.setdefault(trial.experiment, {})
+            if trial.id in exp:
+                raise DuplicateTrialError(trial.id)
+            exp[trial.id] = Trial.from_dict(trial.to_dict())
+
+    def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
+        with self._lock:
+            candidates = [
+                t for t in self._trials.get(experiment, {}).values()
+                if t.status == "new"
+            ]
+            candidates.sort(key=lambda t: (t.submit_time or 0, t.id))
+            if candidates:
+                t = candidates[0]
+                t.transition("reserved")
+                t.worker = worker
+                return Trial.from_dict(t.to_dict())
+        return None
+
+    def update_trial(
+        self,
+        trial: Trial,
+        expected_status: Optional[str] = None,
+        expected_worker: Optional[str] = None,
+    ) -> bool:
+        with self._lock:
+            exp = self._trials.get(trial.experiment, {})
+            stored = exp.get(trial.id)
+            if stored is None:
+                return False
+            if expected_status is not None and stored.status != expected_status:
+                return False
+            if expected_worker is not None and stored.worker != expected_worker:
+                return False
+            exp[trial.id] = Trial.from_dict(trial.to_dict())
+            return True
+
+    def heartbeat(self, experiment: str, trial_id: str, worker: str) -> bool:
+        with self._lock:
+            t = self._trials.get(experiment, {}).get(trial_id)
+            if t is None or t.status != "reserved" or t.worker != worker:
+                return False
+            t.heartbeat = time.time()
+            return True
+
+    def get(self, experiment: str, trial_id: str) -> Optional[Trial]:
+        with self._lock:
+            t = self._trials.get(experiment, {}).get(trial_id)
+            return Trial.from_dict(t.to_dict()) if t else None
+
+    def fetch(self, experiment: str, status=None) -> List[Trial]:
+        statuses = (status,) if isinstance(status, str) else status
+        with self._lock:
+            out = []
+            for t in self._trials.get(experiment, {}).values():
+                if statuses is None or t.status in statuses:
+                    out.append(Trial.from_dict(t.to_dict()))
+            out.sort(key=lambda t: (t.submit_time or 0, t.id))
+            return out
+
+
+# ---------------------------------------------------------------------------
+
+
+@ledger_registry.register("file")
+class FileLedger(LedgerBackend):
+    """Directory-of-JSON ledger with flock-based atomicity.
+
+    Layout: ``<root>/<experiment>/experiment.json``,
+    ``<root>/<experiment>/trials/<id>.json``, ``<root>/<experiment>/.lock``.
+    One coarse lock per experiment: every op takes it for its critical
+    section. This trades throughput for simplicity — trial docs are tiny and
+    trial runtimes are seconds-to-hours, so the lock is never contended in
+    practice (same argument the reference makes for Mongo round-trips).
+    """
+
+    def __init__(self, path: Optional[str] = None, **_: Any) -> None:
+        self.root = path or os.path.expanduser("~/.metaopt_tpu/ledger")
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- internals --------------------------------------------------------
+    def _edir(self, name: str) -> str:
+        # percent-encode so distinct names can never collide on disk
+        safe = urllib.parse.quote(name, safe="")
+        return os.path.join(self.root, safe)
+
+    def _locked(self, name: str):
+        class _Lock:
+            def __init__(self, path: str):
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                self.path = path
+
+            def __enter__(self):
+                self.f = open(self.path, "a+")
+                fcntl.flock(self.f, fcntl.LOCK_EX)
+                return self
+
+            def __exit__(self, *exc):
+                fcntl.flock(self.f, fcntl.LOCK_UN)
+                self.f.close()
+
+        return _Lock(os.path.join(self._edir(name), ".lock"))
+
+    @staticmethod
+    def _write_json(path: str, doc: Dict[str, Any]) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _read_json(path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def _tpath(self, experiment: str, trial_id: str) -> str:
+        return os.path.join(self._edir(experiment), "trials", f"{trial_id}.json")
+
+    # -- experiment docs --------------------------------------------------
+    def create_experiment(self, config: Dict[str, Any]) -> None:
+        name = config["name"]
+        with self._locked(name):
+            epath = os.path.join(self._edir(name), "experiment.json")
+            if os.path.exists(epath):
+                raise DuplicateExperimentError(name)
+            os.makedirs(os.path.join(self._edir(name), "trials"), exist_ok=True)
+            self._write_json(epath, config)
+
+    def load_experiment(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._locked(name):
+            return self._read_json(os.path.join(self._edir(name), "experiment.json"))
+
+    def update_experiment(self, name: str, patch: Dict[str, Any]) -> None:
+        with self._locked(name):
+            epath = os.path.join(self._edir(name), "experiment.json")
+            doc = self._read_json(epath)
+            if doc is None:
+                raise KeyError(name)
+            doc.update(patch)
+            self._write_json(epath, doc)
+
+    def list_experiments(self) -> List[str]:
+        out = []
+        for entry in sorted(os.listdir(self.root)):
+            doc = self._read_json(os.path.join(self.root, entry, "experiment.json"))
+            if doc and "name" in doc:
+                out.append(doc["name"])
+        return sorted(out)
+
+    # -- trials -----------------------------------------------------------
+    def register(self, trial: Trial) -> None:
+        with self._locked(trial.experiment):
+            path = self._tpath(trial.experiment, trial.id)
+            if os.path.exists(path):
+                raise DuplicateTrialError(trial.id)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._write_json(path, trial.to_dict())
+
+    def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
+        with self._locked(experiment):
+            tdir = os.path.join(self._edir(experiment), "trials")
+            if not os.path.isdir(tdir):
+                return None
+            docs = []
+            for fn in os.listdir(tdir):
+                if fn.endswith(".json"):
+                    doc = self._read_json(os.path.join(tdir, fn))
+                    if doc and doc.get("status") == "new":
+                        docs.append(doc)
+            if not docs:
+                return None
+            docs.sort(key=lambda d: (d.get("submit_time") or 0, d["id"]))
+            t = Trial.from_dict(docs[0])
+            t.transition("reserved")
+            t.worker = worker
+            self._write_json(self._tpath(experiment, t.id), t.to_dict())
+            return t
+
+    def update_trial(
+        self,
+        trial: Trial,
+        expected_status: Optional[str] = None,
+        expected_worker: Optional[str] = None,
+    ) -> bool:
+        with self._locked(trial.experiment):
+            path = self._tpath(trial.experiment, trial.id)
+            stored = self._read_json(path)
+            if stored is None:
+                return False
+            if expected_status is not None and stored.get("status") != expected_status:
+                return False
+            if expected_worker is not None and stored.get("worker") != expected_worker:
+                return False
+            self._write_json(path, trial.to_dict())
+            return True
+
+    def heartbeat(self, experiment: str, trial_id: str, worker: str) -> bool:
+        with self._locked(experiment):
+            path = self._tpath(experiment, trial_id)
+            doc = self._read_json(path)
+            if not doc or doc.get("status") != "reserved" or doc.get("worker") != worker:
+                return False
+            doc["heartbeat"] = time.time()
+            self._write_json(path, doc)
+            return True
+
+    def get(self, experiment: str, trial_id: str) -> Optional[Trial]:
+        with self._locked(experiment):
+            doc = self._read_json(self._tpath(experiment, trial_id))
+            return Trial.from_dict(doc) if doc else None
+
+    def fetch(self, experiment: str, status=None) -> List[Trial]:
+        statuses = (status,) if isinstance(status, str) else status
+        with self._locked(experiment):
+            tdir = os.path.join(self._edir(experiment), "trials")
+            out = []
+            if os.path.isdir(tdir):
+                for fn in os.listdir(tdir):
+                    if not fn.endswith(".json"):
+                        continue
+                    doc = self._read_json(os.path.join(tdir, fn))
+                    if doc and (statuses is None or doc.get("status") in statuses):
+                        out.append(Trial.from_dict(doc))
+            out.sort(key=lambda t: (t.submit_time or 0, t.id))
+            return out
+
+
+def make_ledger(config: Dict[str, Any]) -> LedgerBackend:
+    """Build a backend from ``{"type": ..., **kwargs}`` (see ledger_registry)."""
+    cfg = dict(config)
+    kind = cfg.pop("type", "memory")
+    if kind == "coord":  # lazy import to avoid a cycle
+        try:
+            from metaopt_tpu.coord.client_backend import CoordLedgerClient  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "the 'coord' ledger backend requires the coordinator service "
+                f"(metaopt_tpu.coord): {e}"
+            ) from None
+    return ledger_registry.get(kind)(**cfg)

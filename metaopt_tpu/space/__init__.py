@@ -1,0 +1,31 @@
+"""Typed search space.
+
+ref: src/metaopt/algo/space.py (Space, Dimension/Real/Integer/Categorical) and
+the lineage's Fidelity dimension. Sampling here is host-side control-plane work
+over ``numpy.random.Generator``; the algorithm-facing vectorization lives in
+:mod:`metaopt_tpu.space.transforms` so surrogate math can run as jitted JAX.
+"""
+
+from metaopt_tpu.space.dimensions import (
+    Categorical,
+    Dimension,
+    Fidelity,
+    Integer,
+    Real,
+)
+from metaopt_tpu.space.space import Space
+from metaopt_tpu.space.transforms import UnitCube
+from metaopt_tpu.space.builder import SpaceBuilder, parse_prior, build_space
+
+__all__ = [
+    "Dimension",
+    "Real",
+    "Integer",
+    "Categorical",
+    "Fidelity",
+    "Space",
+    "UnitCube",
+    "SpaceBuilder",
+    "parse_prior",
+    "build_space",
+]

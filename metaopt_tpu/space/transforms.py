@@ -1,0 +1,124 @@
+"""Space → unit-cube vectorization for algorithm math.
+
+ref: the lineage's transformer/PrimaryAlgo pair (core/worker/transformer.py,
+core/worker/primary_algo.py): algorithms see a uniform real vector space and
+the wrapper converts on the suggest/observe boundary. Re-designed as a single
+bijection ``UnitCube``: every searchable dimension maps to one column in
+[0, 1], so surrogate models (TPE's KDE, EvolutionES mutations) are plain
+array math that vectorizes/jits cleanly.
+
+Column semantics per dimension type:
+
+- Real uniform       → linear rescale
+- Real loguniform    → log-linear rescale
+- Real normal        → Gaussian CDF (scipy.special.ndtr)
+- Integer            → linear rescale over [low - 0.5, high + 0.5], rounded on
+                       the way back (so each integer owns an equal-width bin)
+- Categorical        → bin center (i + .5)/k, floor on the way back; columns
+                       carrying categoricals are flagged in ``categorical_mask``
+                       so algorithms that want per-category frequencies (TPE)
+                       can treat them specially
+- Fidelity           → excluded (budget is assigned by the algorithm, not
+                       searched)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Mapping, Sequence
+
+import numpy as np
+from scipy.special import ndtr, ndtri
+
+from metaopt_tpu.space.dimensions import Categorical, Fidelity, Integer, Real
+from metaopt_tpu.space.space import Space
+
+_EPS = 1e-12
+
+
+class UnitCube:
+    """Bijection between space points (dicts) and vectors in [0, 1]^d."""
+
+    def __init__(self, space: Space):
+        self.space = space
+        self.dims = [d for d in space.values() if not isinstance(d, Fidelity)]
+        for d in self.dims:
+            if d.shape:
+                raise NotImplementedError(
+                    f"array-shaped dimension {d.name!r} not supported by UnitCube yet"
+                )
+        self.names = [d.name for d in self.dims]
+        self.categorical_mask = np.asarray(
+            [isinstance(d, Categorical) for d in self.dims]
+        )
+        #: number of categories per column (1 for non-categorical)
+        self.n_choices = np.asarray(
+            [len(d.options) if isinstance(d, Categorical) else 1 for d in self.dims]
+        )
+
+    @property
+    def n_dims(self) -> int:
+        return len(self.dims)
+
+    # -- forward ----------------------------------------------------------
+    def _fwd_one(self, dim, value) -> float:
+        if isinstance(dim, Categorical):
+            i = next(j for j, opt in enumerate(dim.options) if opt == value)
+            return (i + 0.5) / len(dim.options)
+        if isinstance(dim, Integer):
+            low, high = dim.interval()
+            return (float(value) - (low - 0.5)) / ((high + 0.5) - (low - 0.5))
+        assert isinstance(dim, Real)
+        if dim.prior_name == "uniform":
+            low, high = dim.interval()
+            return min(1.0, max(0.0, (float(value) - low) / (high - low)))
+        if dim.prior_name == "loguniform":
+            low, high = dim.interval()
+            return min(
+                1.0,
+                max(
+                    0.0,
+                    (math.log(float(value)) - math.log(low))
+                    / (math.log(high) - math.log(low)),
+                ),
+            )
+        # normal
+        return float(ndtr((float(value) - dim._loc) / dim._scale))
+
+    def transform(self, point: Mapping[str, Any]) -> np.ndarray:
+        """Point dict → vector in [0,1]^d (fidelity dropped)."""
+        return np.asarray([self._fwd_one(d, point[d.name]) for d in self.dims])
+
+    def transform_many(self, points: Sequence[Mapping[str, Any]]) -> np.ndarray:
+        if not points:
+            return np.zeros((0, self.n_dims))
+        return np.stack([self.transform(p) for p in points])
+
+    # -- backward ---------------------------------------------------------
+    def _bwd_one(self, dim, u: float):
+        u = min(1.0 - _EPS, max(_EPS, float(u)))
+        if isinstance(dim, Categorical):
+            i = min(len(dim.options) - 1, int(u * len(dim.options)))
+            return dim.options[i]
+        if isinstance(dim, Integer):
+            low, high = dim.interval()
+            v = (low - 0.5) + u * ((high + 0.5) - (low - 0.5))
+            return int(min(high, max(low, round(v))))
+        assert isinstance(dim, Real)
+        if dim.prior_name == "uniform":
+            low, high = dim.interval()
+            return low + u * (high - low)
+        if dim.prior_name == "loguniform":
+            low, high = dim.interval()
+            return math.exp(math.log(low) + u * (math.log(high) - math.log(low)))
+        return dim._loc + dim._scale * float(ndtri(u))
+
+    def untransform(self, vec: np.ndarray) -> Dict[str, Any]:
+        """Vector in [0,1]^d → point dict (without fidelity)."""
+        vec = np.asarray(vec)
+        if vec.shape != (self.n_dims,):
+            raise ValueError(f"expected shape ({self.n_dims},), got {vec.shape}")
+        return {d.name: self._bwd_one(d, u) for d, u in zip(self.dims, vec)}
+
+    def untransform_many(self, mat: np.ndarray) -> List[Dict[str, Any]]:
+        return [self.untransform(row) for row in np.asarray(mat)]

@@ -1,0 +1,11 @@
+"""Shared utilities: plugin registry, deterministic hashing, small helpers.
+
+ref: src/metaopt/core/utils/ (Factory metaclass + pkg_resources entry points in
+the lineage). Re-designed as an explicit decorator-based registry — no
+metaclass magic, no import-time entry-point scanning.
+"""
+
+from metaopt_tpu.utils.registry import Registry
+from metaopt_tpu.utils.hashing import point_hash, stable_json
+
+__all__ = ["Registry", "point_hash", "stable_json"]

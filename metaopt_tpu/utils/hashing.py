@@ -1,0 +1,47 @@
+"""Deterministic hashing of trial points for identity and dedup.
+
+The reference relies on MongoDB unique indexes over trial params for identity
+(ref: src/metaopt/core/io/database/mongodb.py). Without a DB, identity is a
+content hash of the canonical JSON of the params mapping.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+from typing import Any, Mapping
+
+
+def _canon(value: Any) -> Any:
+    """Canonicalize values so that e.g. numpy scalars and Python scalars agree."""
+    if hasattr(value, "item") and not isinstance(value, (str, bytes)):
+        try:
+            value = value.item()
+        except Exception:
+            pass
+    if isinstance(value, float):
+        if math.isnan(value):
+            return "__nan__"
+        # collapse -0.0 / 0.0 and represent with repr for full precision
+        return repr(value + 0.0)
+    if isinstance(value, (list, tuple)):
+        return [_canon(v) for v in value]
+    if isinstance(value, Mapping):
+        return {str(k): _canon(v) for k, v in value.items()}
+    return value
+
+
+def stable_json(obj: Any) -> str:
+    """Canonical JSON: sorted keys, canonicalized scalars."""
+    return json.dumps(_canon(obj), sort_keys=True, separators=(",", ":"))
+
+
+def point_hash(params: Mapping[str, Any], *, ignore: tuple[str, ...] = ()) -> str:
+    """Hash a params mapping; ``ignore`` drops axes (e.g. the fidelity dim,
+
+    so that an ASHA promotion at a higher budget hashes to the same trial
+    lineage as its parent point).
+    """
+    filtered = {k: v for k, v in params.items() if k not in ignore}
+    return hashlib.sha256(stable_json(filtered).encode()).hexdigest()[:24]

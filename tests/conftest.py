@@ -1,0 +1,32 @@
+"""Test harness config.
+
+Per SURVEY.md §7: tests run against a virtual 8-device CPU mesh so multi-chip
+sharding logic is exercised without pod hardware (the local axon backend
+exposes a single real chip; bench.py targets it separately).
+
+The env vars must be set before jax (or anything importing jax) loads.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng_seed():
+    return 1234
+
+
+@pytest.fixture
+def tmp_ledger_dir(tmp_path):
+    d = tmp_path / "ledger"
+    d.mkdir()
+    return str(d)

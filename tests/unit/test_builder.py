@@ -1,0 +1,99 @@
+"""Unit tests for the ~prior DSL parser and command templating.
+
+ref coverage model: the lineage's space_builder tests (SURVEY.md §4).
+"""
+
+import pytest
+
+from metaopt_tpu.space import Categorical, Fidelity, Integer, Real, SpaceBuilder, parse_prior
+from metaopt_tpu.space.builder import PriorSyntaxError
+
+
+class TestParsePrior:
+    def test_real(self):
+        d = parse_prior("lr", "loguniform(1e-5, 1e-1)")
+        assert isinstance(d, Real) and d.prior_name == "loguniform"
+        assert d.interval() == (1e-5, 1e-1)
+
+    def test_discrete_flag_routes_to_integer(self):
+        d = parse_prior("layers", "uniform(1, 8, discrete=True)")
+        assert isinstance(d, Integer)
+        assert d.interval() == (1, 8)
+
+    def test_choices_list(self):
+        d = parse_prior("opt", "choices(['adam', 'sgd'])")
+        assert isinstance(d, Categorical) and d.options == ["adam", "sgd"]
+
+    def test_choices_weighted(self):
+        d = parse_prior("opt", "choices({'adam': 0.75, 'sgd': 0.25})")
+        assert d.probabilities[0] == pytest.approx(0.75)
+
+    def test_fidelity(self):
+        d = parse_prior("epochs", "fidelity(1, 16, base=4)")
+        assert isinstance(d, Fidelity) and d.rungs() == [1, 4, 16]
+
+    def test_negative_numbers(self):
+        d = parse_prior("x", "uniform(-50, 50)")
+        assert d.interval() == (-50.0, 50.0)
+
+    def test_default_value(self):
+        d = parse_prior("x", "uniform(0, 1, default_value=0.5)")
+        assert d.default_value == 0.5
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "uniform(0, 1) + 1",
+            "__import__('os').system('x')",
+            "uniform(a, b)",
+            "notaprior(1, 2)",
+            "uniform(0)",
+        ],
+    )
+    def test_rejects_non_literal_or_unknown(self, bad):
+        with pytest.raises((PriorSyntaxError, ValueError)):
+            parse_prior("x", bad)
+
+
+class TestSpaceBuilderArgv:
+    def test_parse_and_template(self):
+        argv = [
+            "./train.py",
+            "--lr~loguniform(1e-5, 1e-1)",
+            "--layers~uniform(1, 8, discrete=True)",
+            "--data", "cifar10",
+            "-x~uniform(-50, 50)",
+        ]
+        space, tmpl = SpaceBuilder().build(argv)
+        assert set(space.keys()) == {"lr", "layers", "x"}
+        out = tmpl.format({"lr": 0.001, "layers": 4, "x": 1.5})
+        assert out[0] == "./train.py"
+        assert "--lr=0.001" in out and "--layers=4" in out and "-x=1.5" in out
+        assert "--data" in out and "cifar10" in out
+
+    def test_no_priors(self):
+        space, tmpl = SpaceBuilder().build(["./train.py", "--flag"])
+        assert len(space) == 0
+        assert tmpl.format({}) == ["./train.py", "--flag"]
+
+
+class TestSpaceBuilderConfigFile:
+    def test_yaml_template(self, tmp_path):
+        cfg = tmp_path / "conf.yaml"
+        cfg.write_text(
+            "model:\n  width: '~uniform(32, 512, discrete=True)'\n"
+            "lr: 'lr~loguniform(1e-4, 1e-1)'\nepochs: 10\n"
+        )
+        argv = ["./train.py", "--config", str(cfg)]
+        space, tmpl = SpaceBuilder().build(argv)
+        assert set(space.keys()) == {"width", "lr"}
+        out_cfg = tmp_path / "trial_conf.yaml"
+        tmpl.materialize_config({"width": 64, "lr": 0.01}, str(out_cfg))
+        import yaml
+
+        data = yaml.safe_load(out_cfg.read_text())
+        assert data["model"]["width"] == 64
+        assert data["lr"] == 0.01
+        assert data["epochs"] == 10
+        argv_out = tmpl.format({"width": 64, "lr": 0.01}, config_out=str(out_cfg))
+        assert str(out_cfg) in argv_out

@@ -1,0 +1,79 @@
+"""Experiment create-or-load, registration dedup, completion semantics.
+
+ref coverage model: tests/unittests/core/worker/test_experiment.py.
+"""
+
+import pytest
+
+from metaopt_tpu.ledger import Experiment, MemoryLedger
+from metaopt_tpu.space import build_space
+
+
+@pytest.fixture
+def space():
+    return build_space({"x": "uniform(-5, 5)", "epochs": "fidelity(1, 4, base=2)"})
+
+
+@pytest.fixture
+def ledger():
+    return MemoryLedger()
+
+
+def _exp(ledger, space, name="demo", **kw):
+    return Experiment(name, ledger, space=space, max_trials=kw.pop("max_trials", 3),
+                      algorithm={"random": {"seed": 1}}, **kw)
+
+
+def test_configure_creates_then_loads(ledger, space):
+    e1 = _exp(ledger, space).configure()
+    assert e1.space == space
+    # a second worker with no space adopts the stored config
+    e2 = Experiment("demo", ledger).configure()
+    assert e2.space == space
+    assert e2.algorithm == {"random": {"seed": 1}}
+    assert e2.max_trials == 3
+
+
+def test_configure_without_space_on_missing_exp(ledger):
+    with pytest.raises(ValueError):
+        Experiment("ghost", ledger).configure()
+
+
+def test_register_dedups_lost_races(ledger, space):
+    e = _exp(ledger, space).configure()
+    t1 = e.make_trial({"x": 1.0, "epochs": 4})
+    t2 = e.make_trial({"x": 1.0, "epochs": 4})  # same point → same id
+    kept = e.register_trials([t1, t2])
+    assert len(kept) == 1
+    assert e.count() == 1
+
+
+def test_lineage_vs_id_for_promotions(ledger, space):
+    e = _exp(ledger, space).configure()
+    low = e.make_trial({"x": 1.0, "epochs": 1})
+    high = e.make_trial({"x": 1.0, "epochs": 4}, parent=low.id)
+    assert low.id != high.id          # distinct trials
+    assert low.lineage == high.lineage  # same search point
+    assert high.parent == low.id
+    assert len(e.register_trials([low, high])) == 2
+
+
+def test_reserve_push_results_is_done(ledger, space):
+    e = _exp(ledger, space, max_trials=2).configure()
+    e.register_trials([e.make_trial({"x": float(i), "epochs": 4}) for i in range(3)])
+    done = 0
+    while not e.is_done:
+        t = e.reserve_trial("w0")
+        assert t is not None
+        assert e.push_results(t, [{"name": "y", "type": "objective", "value": t.params["x"] ** 2}])
+        done += 1
+    assert done == 2
+    assert e.stats["best"]["objective"] == 0.0
+    assert e.stats["by_status"]["completed"] == 2
+
+
+def test_mark_algo_done(ledger, space):
+    e = _exp(ledger, space, max_trials=100).configure()
+    assert not e.is_done
+    e.mark_algo_done()
+    assert e.is_done

@@ -1,0 +1,142 @@
+"""Ledger backend contract tests, run against every backend.
+
+ref coverage model: tests/unittests/core/io/database/ (SURVEY.md §4) — CRUD,
+atomic reservation, duplicate-key races. The multi-process race tier for
+FileLedger lives in tests/functional/test_races.py.
+"""
+
+import time
+
+import pytest
+
+from metaopt_tpu.ledger import (
+    DuplicateTrialError,
+    FileLedger,
+    MemoryLedger,
+    Trial,
+)
+from metaopt_tpu.ledger.backends import DuplicateExperimentError
+
+
+@pytest.fixture(params=["memory", "file"])
+def ledger(request, tmp_path):
+    if request.param == "memory":
+        return MemoryLedger()
+    return FileLedger(path=str(tmp_path / "ledger"))
+
+
+def _trial(x, exp="exp", status="new"):
+    t = Trial(params={"x": x}, experiment=exp)
+    if status != "new":
+        t.transition(status)
+    return t
+
+
+class TestExperimentDocs:
+    def test_create_load(self, ledger):
+        ledger.create_experiment({"name": "exp", "max_trials": 5})
+        doc = ledger.load_experiment("exp")
+        assert doc["max_trials"] == 5
+        assert ledger.load_experiment("nope") is None
+
+    def test_duplicate_create_raises(self, ledger):
+        ledger.create_experiment({"name": "exp"})
+        with pytest.raises(DuplicateExperimentError):
+            ledger.create_experiment({"name": "exp"})
+
+    def test_update_and_list(self, ledger):
+        ledger.create_experiment({"name": "exp"})
+        ledger.update_experiment("exp", {"algo_done": True})
+        assert ledger.load_experiment("exp")["algo_done"] is True
+        assert ledger.list_experiments() == ["exp"]
+
+
+class TestTrialOps:
+    def test_register_and_get(self, ledger):
+        t = _trial(1.0)
+        ledger.register(t)
+        got = ledger.get("exp", t.id)
+        assert got.params == {"x": 1.0} and got.status == "new"
+
+    def test_register_duplicate_raises(self, ledger):
+        ledger.register(_trial(1.0))
+        with pytest.raises(DuplicateTrialError):
+            ledger.register(_trial(1.0))
+
+    def test_reserve_atomic_winner_takes_one(self, ledger):
+        ledger.register(_trial(1.0))
+        t1 = ledger.reserve("exp", "w1")
+        assert t1 is not None and t1.status == "reserved" and t1.worker == "w1"
+        assert ledger.reserve("exp", "w2") is None  # nothing left
+
+    def test_reserve_order_fifo(self, ledger):
+        a, b = _trial(1.0), _trial(2.0)
+        a.submit_time, b.submit_time = 100.0, 200.0
+        ledger.register(b)
+        ledger.register(a)
+        assert ledger.reserve("exp", "w").params == {"x": 1.0}
+
+    def test_update_cas(self, ledger):
+        t = _trial(1.0)
+        ledger.register(t)
+        r = ledger.reserve("exp", "w1")
+        r.attach_results([{"name": "l", "type": "objective", "value": 3.0}])
+        r.transition("completed")
+        assert ledger.update_trial(r, expected_status="reserved")
+        # second CAS on the old expectation fails
+        assert not ledger.update_trial(r, expected_status="reserved")
+        assert ledger.get("exp", t.id).objective == 3.0
+
+    def test_fetch_by_status_and_count(self, ledger):
+        for x in (1.0, 2.0, 3.0):
+            ledger.register(_trial(x))
+        ledger.reserve("exp", "w")
+        assert ledger.count("exp") == 3
+        assert ledger.count("exp", "new") == 2
+        assert ledger.count("exp", ("new", "reserved")) == 3
+
+    def test_heartbeat_ownership(self, ledger):
+        ledger.register(_trial(1.0))
+        r = ledger.reserve("exp", "w1")
+        assert ledger.heartbeat("exp", r.id, "w1")
+        assert not ledger.heartbeat("exp", r.id, "w2")  # not the owner
+        assert not ledger.heartbeat("exp", "missing", "w1")
+
+    def test_release_stale(self, ledger):
+        ledger.register(_trial(1.0))
+        r = ledger.reserve("exp", "w1")
+        # backdate the heartbeat
+        r.heartbeat = time.time() - 1000
+        assert ledger.update_trial(r, expected_status="reserved")
+        released = ledger.release_stale("exp", timeout_s=60)
+        assert [t.id for t in released] == [r.id]
+        again = ledger.reserve("exp", "w2")
+        assert again is not None and again.worker == "w2"
+
+
+class TestRegressionFixes:
+    def test_aba_stale_worker_cannot_clobber(self, ledger):
+        """A released-then-reissued reservation must reject the old owner's write."""
+        ledger.register(_trial(1.0))
+        t_a = ledger.reserve("exp", "wA")
+        # wA stalls; reservation goes stale and is released
+        t_a.heartbeat = time.time() - 1000
+        assert ledger.update_trial(t_a, expected_status="reserved")
+        ledger.release_stale("exp", timeout_s=60)
+        t_b = ledger.reserve("exp", "wB")
+        assert t_b.worker == "wB"
+        # wA wakes up and tries to complete its stale copy
+        t_a.attach_results([{"name": "l", "type": "objective", "value": 9.0}])
+        t_a.status = "completed"
+        assert not ledger.update_trial(
+            t_a, expected_status="reserved", expected_worker="wA"
+        )
+        stored = ledger.get("exp", t_b.id)
+        assert stored.status == "reserved" and stored.worker == "wB"
+
+    def test_experiment_names_never_collide(self, ledger):
+        ledger.create_experiment({"name": "team/run"})
+        ledger.create_experiment({"name": "team_run"})  # must NOT collide
+        assert ledger.load_experiment("team/run")["name"] == "team/run"
+        assert ledger.load_experiment("team_run")["name"] == "team_run"
+        assert ledger.list_experiments() == ["team/run", "team_run"]

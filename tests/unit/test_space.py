@@ -1,0 +1,200 @@
+"""Unit tests for dimensions and Space.
+
+Mirrors the reference's tests/unittests/algo/test_space.py coverage model
+(SURVEY.md §4): sampling determinism, interval, containment, configuration
+round-trips, fidelity rungs.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from metaopt_tpu.space import (
+    Categorical,
+    Fidelity,
+    Integer,
+    Real,
+    Space,
+)
+
+
+class TestReal:
+    def test_uniform_sample_bounds_and_determinism(self):
+        dim = Real("x", "uniform", -5, 5)
+        s1 = dim.sample(100, seed=7)
+        s2 = dim.sample(100, seed=7)
+        assert s1 == s2
+        assert all(-5 <= v < 5 for v in s1)
+        assert dim.interval() == (-5.0, 5.0)
+
+    def test_loguniform(self):
+        dim = Real("lr", "loguniform", 1e-5, 1e-1)
+        s = dim.sample(500, seed=0)
+        assert all(1e-5 <= v <= 1e-1 for v in s)
+        # log-uniformity: median of logs near the middle of the log range
+        logs = np.log10(s)
+        assert -4.5 < np.median(logs) < -1.5
+
+    def test_normal_unbounded(self):
+        dim = Real("z", "normal", 0, 1)
+        assert dim.interval() == (-math.inf, math.inf)
+        assert 123.0 in dim
+        s = np.asarray(dim.sample(2000, seed=3))
+        assert abs(s.mean()) < 0.1
+
+    def test_containment(self):
+        dim = Real("x", "uniform", 0, 1)
+        assert 0.5 in dim
+        assert 0.0 in dim and 1.0 in dim
+        assert 1.5 not in dim
+        assert "a" not in dim
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Real("x", "uniform", 5, -5)
+        with pytest.raises(ValueError):
+            Real("x", "loguniform", 0, 1)
+        with pytest.raises(ValueError):
+            Real("x", "frobnicate", 0, 1)
+
+    def test_precision(self):
+        dim = Real("x", "uniform", 0, 1, precision=2)
+        s = dim.sample(50, seed=1)
+        assert all(float(f"%.2g" % v) == v for v in s)
+
+    def test_shape(self):
+        dim = Real("w", "uniform", 0, 1, shape=(3,))
+        s = dim.sample(4, seed=0)
+        assert len(s) == 4 and s[0].shape == (3,)
+        assert s[0] in dim
+        assert np.array([2.0, 0.1, 0.2]) not in dim
+
+
+class TestInteger:
+    def test_uniform_discrete_inclusive(self):
+        dim = Integer("layers", "uniform", 1, 8)
+        s = dim.sample(200, seed=5)
+        assert set(s) <= set(range(1, 9))
+        assert 8 in set(s)  # inclusive upper bound reachable
+        assert all(isinstance(v, int) for v in s)
+
+    def test_randint_exclusive_high(self):
+        dim = Integer("k", "randint", 0, 4)
+        assert dim.interval() == (0, 3)
+
+    def test_containment_rejects_floats(self):
+        dim = Integer("n", "uniform", 1, 10)
+        assert 3 in dim
+        assert 3.0 in dim  # integral float ok
+        assert 3.5 not in dim
+        assert 11 not in dim
+
+    def test_cardinality(self):
+        assert Integer("n", "uniform", 1, 8).cardinality == 8
+
+
+class TestCategorical:
+    def test_list_options(self):
+        dim = Categorical("opt", "choices", ["adam", "sgd", "rmsprop"])
+        s = dim.sample(100, seed=2)
+        assert set(s) == {"adam", "sgd", "rmsprop"}
+        assert "adam" in dim and "momentum" not in dim
+        assert dim.cardinality == 3
+
+    def test_weighted_dict(self):
+        dim = Categorical("c", "choices", {"a": 0.9, "b": 0.1})
+        s = dim.sample(1000, seed=0)
+        assert s.count("a") > 700
+        with pytest.raises(ValueError):
+            Categorical("c", "choices", {"a": 0.5, "b": 0.2})
+
+    def test_varargs_and_mixed_types(self):
+        dim = Categorical("c", "choices", 1, "two", 3.0)
+        assert 1 in dim and "two" in dim and 3.0 in dim
+
+
+class TestFidelity:
+    def test_rungs(self):
+        dim = Fidelity("epochs", "fidelity", 1, 16, base=4)
+        assert dim.rungs() == [1, 4, 16]
+        dim = Fidelity("epochs", "fidelity", 1, 81, base=3)
+        assert dim.rungs() == [1, 3, 9, 27, 81]
+        dim = Fidelity("epochs", "fidelity", 5, 30, base=2)
+        assert dim.rungs() == [5, 10, 20, 30]
+
+    def test_sample_returns_max_budget(self):
+        dim = Fidelity("epochs", "fidelity", 1, 100, base=2)
+        assert dim.sample(3, seed=0) == [100, 100, 100]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Fidelity("f", "fidelity", 10, 5)
+        with pytest.raises(ValueError):
+            Fidelity("f", "fidelity", 0, 5)
+
+
+class TestSpace:
+    def _space(self):
+        s = Space()
+        s.register(Real("lr", "loguniform", 1e-5, 1e-1))
+        s.register(Integer("layers", "uniform", 1, 8))
+        s.register(Categorical("opt", "choices", ["adam", "sgd"]))
+        return s
+
+    def test_joint_sample_dicts(self):
+        space = self._space()
+        pts = space.sample(10, seed=42)
+        assert len(pts) == 10
+        for p in pts:
+            assert set(p) == {"lr", "layers", "opt"}
+            assert p in space
+
+    def test_sample_determinism(self):
+        space = self._space()
+        assert space.sample(5, seed=9) == space.sample(5, seed=9)
+
+    def test_containment(self):
+        space = self._space()
+        assert {"lr": 1e-3, "layers": 4, "opt": "adam"} in space
+        assert {"lr": 10.0, "layers": 4, "opt": "adam"} not in space
+        assert {"lr": 1e-3, "layers": 4} not in space  # missing key
+        assert "lr" in space  # name lookup
+
+    def test_duplicate_name_rejected(self):
+        space = self._space()
+        with pytest.raises(ValueError):
+            space.register(Real("lr", "uniform", 0, 1))
+
+    def test_fidelity_property_and_hash(self):
+        space = self._space()
+        assert space.fidelity is None
+        space.register(Fidelity("epochs", "fidelity", 1, 16, base=4))
+        assert space.fidelity.name == "epochs"
+        p1 = {"lr": 1e-3, "layers": 4, "opt": "adam", "epochs": 1}
+        p2 = {"lr": 1e-3, "layers": 4, "opt": "adam", "epochs": 16}
+        # fidelity excluded from identity → promotion keeps lineage id
+        assert space.hash_point(p1) == space.hash_point(p2)
+        assert space.hash_point(p1, with_fidelity=True) != space.hash_point(
+            p2, with_fidelity=True
+        )
+
+    def test_cardinality(self):
+        s = Space()
+        s.register(Integer("a", "uniform", 1, 4))
+        s.register(Categorical("b", "choices", ["x", "y"]))
+        assert s.cardinality == 8
+
+    def test_configuration_roundtrip(self):
+        from metaopt_tpu.space import build_space
+
+        space = self._space()
+        rebuilt = build_space(space.configuration)
+        assert rebuilt == space
+
+
+def test_precision_rounding_stays_in_bounds():
+    """%g rounding must not push samples past the interval edge."""
+    dim = Real("x", "uniform", 0, 0.096, precision=1)
+    s = dim.sample(2000, seed=0)
+    assert all(v in dim for v in s)

@@ -1,0 +1,67 @@
+"""Unit tests for the unit-cube bijection used by algorithm math."""
+
+import numpy as np
+import pytest
+
+from metaopt_tpu.space import Categorical, Fidelity, Integer, Real, Space, UnitCube
+
+
+@pytest.fixture
+def space():
+    s = Space()
+    s.register(Real("u", "uniform", -2, 6))
+    s.register(Real("lr", "loguniform", 1e-5, 1e-1))
+    s.register(Real("z", "normal", 1.0, 2.0))
+    s.register(Integer("n", "uniform", 1, 8))
+    s.register(Categorical("c", "choices", ["a", "b", "c"]))
+    s.register(Fidelity("epochs", "fidelity", 1, 16, base=4))
+    return s
+
+
+def test_fidelity_excluded(space):
+    cube = UnitCube(space)
+    assert cube.names == ["u", "lr", "z", "n", "c"]
+    assert cube.n_dims == 5
+
+
+def test_roundtrip_exact_for_discrete(space):
+    cube = UnitCube(space)
+    for pt in space.sample(50, seed=11):
+        vec = cube.transform(pt)
+        assert vec.shape == (5,)
+        assert np.all(vec >= 0) and np.all(vec <= 1)
+        back = cube.untransform(vec)
+        assert back["n"] == pt["n"]
+        assert back["c"] == pt["c"]
+        assert back["u"] == pytest.approx(pt["u"], rel=1e-9)
+        assert back["lr"] == pytest.approx(pt["lr"], rel=1e-9)
+        assert back["z"] == pytest.approx(pt["z"], rel=1e-6)
+
+
+def test_untransform_clips_to_bounds(space):
+    cube = UnitCube(space)
+    pt0 = cube.untransform(np.zeros(5))
+    pt1 = cube.untransform(np.ones(5))
+    assert pt0["u"] == pytest.approx(-2, abs=1e-6)
+    assert pt1["u"] == pytest.approx(6, abs=1e-6)
+    assert pt0["n"] == 1 and pt1["n"] == 8
+    assert pt0["c"] == "a" and pt1["c"] == "c"
+    # all reconstructed points are inside the space once fidelity is added
+    pt0["epochs"] = 16
+    assert pt0 in space
+
+
+def test_categorical_mask(space):
+    cube = UnitCube(space)
+    assert cube.categorical_mask.tolist() == [False, False, False, False, True]
+    assert cube.n_choices.tolist() == [1, 1, 1, 1, 3]
+
+
+def test_transform_many_shapes(space):
+    cube = UnitCube(space)
+    pts = space.sample(7, seed=3)
+    mat = cube.transform_many(pts)
+    assert mat.shape == (7, 5)
+    backs = cube.untransform_many(mat)
+    assert [b["n"] for b in backs] == [p["n"] for p in pts]
+    assert cube.transform_many([]).shape == (0, 5)

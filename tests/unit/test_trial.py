@@ -1,0 +1,75 @@
+"""Unit tests for the Trial value object (status lifecycle, results, dict I/O).
+
+ref coverage model: tests/unittests/core/worker/test_trial.py (SURVEY.md §4).
+"""
+
+import pytest
+
+from metaopt_tpu.ledger.trial import InvalidTrialTransition, Result, Trial
+
+
+def test_defaults_and_id():
+    t = Trial(params={"x": 1.5})
+    assert t.status == "new"
+    assert t.id  # content hash assigned
+    assert t.submit_time is not None
+    t2 = Trial(params={"x": 1.5})
+    assert t2.id == t.id  # identity is content-addressed
+
+
+def test_lifecycle_happy_path():
+    t = Trial(params={"x": 1})
+    t.transition("reserved")
+    assert t.start_time is not None and t.heartbeat is not None
+    t.transition("completed")
+    assert t.end_time is not None
+
+
+@pytest.mark.parametrize("bad", ["completed", "broken", "suspended"])
+def test_new_cannot_jump_to_terminal(bad):
+    t = Trial(params={"x": 1})
+    with pytest.raises(InvalidTrialTransition):
+        t.transition(bad)
+
+
+def test_completed_is_terminal():
+    t = Trial(params={"x": 1})
+    t.transition("reserved")
+    t.transition("completed")
+    with pytest.raises(InvalidTrialTransition):
+        t.transition("new")
+
+
+def test_interrupted_can_requeue():
+    t = Trial(params={"x": 1})
+    t.transition("reserved")
+    t.transition("interrupted")
+    t.transition("new")
+    assert t.status == "new"
+
+
+def test_results_typed():
+    t = Trial(params={"x": 1})
+    t.attach_results(
+        [
+            {"name": "loss", "type": "objective", "value": 0.25},
+            {"name": "mem", "type": "constraint", "value": 12.0},
+            {"name": "g", "type": "gradient", "value": [0.1, -0.2]},
+        ]
+    )
+    assert t.objective == 0.25
+    assert len(t.constraints) == 1
+    assert t.gradient.value == [0.1, -0.2]
+    with pytest.raises(ValueError):
+        Result("bad", "notatype", 1)
+
+
+def test_dict_roundtrip():
+    t = Trial(params={"x": 1, "opt": "adam"}, experiment="exp")
+    t.transition("reserved")
+    t.worker = "w1"
+    t.resources = {"chips": [0, 1]}
+    t.attach_results([{"name": "loss", "type": "objective", "value": 1.0}])
+    t2 = Trial.from_dict(t.to_dict())
+    assert t2.to_dict() == t.to_dict()
+    assert t2.objective == 1.0
