@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""BASELINE config 2: TPE on MLP/MNIST-shaped task (4 hparams, single chip).
+
+    python -m metaopt_tpu hunt -n mlp --max-trials 40 \
+        --config examples/tpe.yaml \
+        examples/mlp_mnist.py \
+        --lr~'loguniform(1e-4, 1e-1)' \
+        --width~'uniform(64, 1024, discrete=True)' \
+        --depth~'uniform(1, 6, discrete=True)' \
+        --dropout~'uniform(0.0, 0.5)'
+"""
+
+import argparse
+
+from metaopt_tpu.client import report_results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, required=True)
+    p.add_argument("--width", type=int, required=True)
+    p.add_argument("--depth", type=int, required=True)
+    p.add_argument("--dropout", type=float, default=0.1)
+    p.add_argument("--epochs", type=int, default=3)
+    a = p.parse_args()
+
+    from metaopt_tpu.models.mlp import train_and_eval
+
+    err = train_and_eval(
+        {"lr": a.lr, "width": a.width, "depth": a.depth, "dropout": a.dropout},
+        epochs=a.epochs,
+    )
+    report_results([
+        {"name": "val_error", "type": "objective", "value": err},
+    ])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""BASELINE config 5: EvolutionES population search on PPO (gang-scheduled).
+
+    python -m metaopt_tpu hunt -n ppo --max-trials 60 --n-chips 1 \
+        --config examples/evolution.yaml \
+        examples/ppo_atari.py \
+        --lr~'loguniform(1e-5, 1e-2)' \
+        --clip-eps~'uniform(0.05, 0.4)' \
+        --ent-coef~'loguniform(1e-4, 1e-1)' \
+        --gae-lambda~'uniform(0.8, 1.0)' \
+        --epochs~'fidelity(2, 32, base=2)'
+"""
+
+import argparse
+
+from metaopt_tpu.client import report_results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, required=True)
+    p.add_argument("--clip-eps", dest="clip_eps", type=float, default=0.2)
+    p.add_argument("--ent-coef", dest="ent_coef", type=float, default=0.01)
+    p.add_argument("--gae-lambda", dest="gae_lambda", type=float, default=0.95)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=8)
+    a = p.parse_args()
+
+    from metaopt_tpu.models.ppo import train
+
+    neg_return = train(
+        {
+            "lr": a.lr, "clip_eps": a.clip_eps, "ent_coef": a.ent_coef,
+            "gae_lambda": a.gae_lambda, "hidden": a.hidden,
+        },
+        iterations=a.epochs,
+    )
+    report_results(
+        [{"name": "neg_return", "type": "objective", "value": neg_return}]
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""BASELINE config 3: ASHA on ResNet-50/CIFAR-shaped task (multi-fidelity).
+
+    python -m metaopt_tpu hunt -n resnet --max-trials 64 --n-chips 1 \
+        --config examples/asha.yaml \
+        examples/resnet_cifar.py \
+        --lr~'loguniform(1e-3, 1.0)' \
+        --momentum~'uniform(0.8, 0.99)' \
+        --weight-decay~'loguniform(1e-6, 1e-2)' \
+        --epochs~'fidelity(1, 16, base=4)'
+
+Streams per-epoch validation error via report_partial so the coordinator's
+judge hook can prune mid-trial.
+"""
+
+import argparse
+
+from metaopt_tpu.client import report_partial, report_results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, required=True)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", dest="weight_decay", type=float, default=1e-4)
+    p.add_argument("--batch-size", dest="batch_size", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--depth", type=int, default=50)
+    a = p.parse_args()
+
+    from metaopt_tpu.models.resnet import train_and_eval
+
+    hp = {
+        "lr": a.lr, "momentum": a.momentum, "weight_decay": a.weight_decay,
+        "batch_size": a.batch_size, "depth": a.depth,
+    }
+    err = None
+    for epoch in range(1, a.epochs + 1):
+        err = train_and_eval(hp, epochs=1, seed=epoch)
+        report_partial(err, epoch)
+    report_results([{"name": "val_error", "type": "objective", "value": err}])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""BASELINE config 4: Hyperband on Transformer-base (4-chip sub-slice).
+
+    python -m metaopt_tpu hunt -n wmt --max-trials 27 --n-chips 4 \
+        --config examples/hyperband.yaml \
+        examples/transformer_wmt.py \
+        --lr~'loguniform(1e-4, 5e-3)' \
+        --dropout~'uniform(0.0, 0.3)' \
+        --warmup~'uniform(100, 4000, discrete=True)' \
+        --epochs~'fidelity(1, 9, base=3)'
+
+The trial shards dp×tp over exactly the chips its sub-slice grant names
+(MTPU_ASSIGNED_CHIPS), via metaopt_tpu.parallel.trial_mesh.
+"""
+
+import argparse
+
+from metaopt_tpu.client import report_results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, required=True)
+    p.add_argument("--dropout", type=float, default=0.1)
+    p.add_argument("--warmup", type=int, default=400)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--tp", type=int, default=2)
+    p.add_argument("--steps-per-epoch", type=int, default=50)
+    a = p.parse_args()
+
+    from metaopt_tpu.models.transformer import train_and_eval
+
+    loss = train_and_eval(
+        {"lr": a.lr, "dropout": a.dropout, "warmup": a.warmup},
+        tp=a.tp,
+        steps=a.epochs * a.steps_per_epoch,
+    )
+    report_results([{"name": "loss", "type": "objective", "value": loss}])
+
+
+if __name__ == "__main__":
+    main()

@@ -27,17 +27,31 @@ public lineage"; they are design targets, not verified line cites.)
 
 __version__ = "0.1.0"
 
-from metaopt_tpu.space import Space, Real, Integer, Categorical, Fidelity
-from metaopt_tpu.ledger.trial import Trial
-from metaopt_tpu.client import report_results
+#: Lazy attribute table (PEP 562). The root import must stay cheap: every
+#: trial subprocess runs ``from metaopt_tpu.client import report_results``,
+#: and an eager root would make each trial pay the scipy/numpy import chain.
+_LAZY = {
+    "Space": ("metaopt_tpu.space", "Space"),
+    "Real": ("metaopt_tpu.space", "Real"),
+    "Integer": ("metaopt_tpu.space", "Integer"),
+    "Categorical": ("metaopt_tpu.space", "Categorical"),
+    "Fidelity": ("metaopt_tpu.space", "Fidelity"),
+    "Trial": ("metaopt_tpu.ledger.trial", "Trial"),
+    "report_results": ("metaopt_tpu.client", "report_results"),
+}
 
-__all__ = [
-    "Space",
-    "Real",
-    "Integer",
-    "Categorical",
-    "Fidelity",
-    "Trial",
-    "report_results",
-    "__version__",
-]
+__all__ = [*_LAZY, "__version__"]
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,8 @@
+"""``python -m metaopt_tpu`` entry point."""
+
+import sys
+
+from metaopt_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

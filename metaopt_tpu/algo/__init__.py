@@ -1,0 +1,32 @@
+"""Optimization algorithms.
+
+ref: src/metaopt/algo/ (SURVEY.md §2.3). The BaseAlgorithm contract —
+``suggest / observe / is_done / score / judge / should_suspend /
+configuration / seed_rng`` — is preserved; algorithm *state* is kept
+explicitly serializable (``state_dict`` / ``load_state_dict``) so the
+coordinator can snapshot and observe-replay on restart.
+
+Implementations: Random, GradientDescent (exercises the gradient-result
+protocol), TPE (KDE surrogate + EI as jit/vmap JAX — the north-star hot
+path), Hyperband, ASHA, EvolutionES, plus the test-support DumbAlgo.
+"""
+
+from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry, make_algorithm
+from metaopt_tpu.algo.random_search import Random
+from metaopt_tpu.algo.gradient_descent import GradientDescent
+from metaopt_tpu.algo.tpe import TPE
+from metaopt_tpu.algo.hyperband import Hyperband
+from metaopt_tpu.algo.asha import ASHA
+from metaopt_tpu.algo.evolution_es import EvolutionES
+
+__all__ = [
+    "BaseAlgorithm",
+    "algo_registry",
+    "make_algorithm",
+    "Random",
+    "GradientDescent",
+    "TPE",
+    "Hyperband",
+    "ASHA",
+    "EvolutionES",
+]

@@ -1,0 +1,123 @@
+"""The algorithm contract.
+
+ref: src/metaopt/algo/base.py (SURVEY.md §2.3): an ABC with
+``suggest(num)``, ``observe(...)``, ``is_done``, ``score``, ``judge`` (the
+dynamic per-trial early-stop hook), ``should_suspend``, ``configuration``,
+``seed_rng``, discovered through a plugin factory. Differences here, by
+design:
+
+- ``observe`` takes :class:`~metaopt_tpu.ledger.trial.Trial` objects (they
+  carry params, objective, fidelity, status, and lineage in one value object)
+  instead of parallel points/results lists;
+- state is explicitly serializable (``state_dict``/``load_state_dict``) so the
+  coordinator can snapshot + replay (SURVEY.md §5 checkpoint/resume);
+- registration is the explicit :data:`algo_registry` decorator, not entry
+  points.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space import Space
+from metaopt_tpu.utils.registry import Registry
+
+algo_registry: Registry = Registry("algorithm")
+
+
+class BaseAlgorithm(ABC):
+    """Pluggable optimizer over a :class:`Space`.
+
+    The Producer drives it: ``observe(completed_trials)`` then
+    ``suggest(num)``; both must be cheap relative to trial runtime, and
+    ``suggest`` may return fewer points than asked (or none, when the
+    algorithm is waiting on in-flight trials — e.g. ASHA rungs full).
+    """
+
+    #: set by multi-fidelity algorithms; checked at construction time
+    requires_fidelity: bool = False
+
+    def __init__(self, space: Space, seed: Optional[int] = None, **config: Any):
+        self.space = space
+        self._config = dict(config, seed=seed)
+        if self.requires_fidelity and space.fidelity is None:
+            raise ValueError(
+                f"{type(self).__name__} needs a fidelity dimension, e.g. "
+                "epochs~fidelity(1, 16, base=4)"
+            )
+        self.rng = np.random.default_rng(seed)
+        self._observed: Dict[str, float] = {}  # trial id -> objective
+
+    # -- core contract ----------------------------------------------------
+    @abstractmethod
+    def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
+        """Propose up to ``num`` new points (param dicts incl. fidelity)."""
+
+    def observe(self, trials: Sequence[Trial]) -> None:
+        """Ingest completed trials. Idempotent per trial id (replay-safe)."""
+        for t in trials:
+            if t.id in self._observed:
+                continue
+            obj = t.objective
+            if obj is None:
+                continue
+            self._observed[t.id] = obj
+            self._observe_one(t)
+
+    def _observe_one(self, trial: Trial) -> None:  # subclass hook
+        pass
+
+    @property
+    def n_observed(self) -> int:
+        return len(self._observed)
+
+    @property
+    def is_done(self) -> bool:
+        """True when the algorithm cannot usefully continue (space exhausted)."""
+        return self.n_observed >= self.space.cardinality
+
+    # -- optional hooks ----------------------------------------------------
+    def score(self, point: Dict[str, Any]) -> float:
+        """Rank candidate points (higher is better); default indifferent."""
+        return 0.0
+
+    def judge(self, trial: Trial, partial: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+        """Dynamic early-stop hook: given streaming partial results
+
+        (``[{"objective": ..., "step": ...}, ...]`` from
+        ``client.report_partial``), return ``{"stop": True}`` to prune the
+        running trial, or None to let it run. ref: BaseAlgorithm.judge.
+        """
+        return None
+
+    def should_suspend(self, trial: Trial) -> bool:
+        return False
+
+    # -- reproducibility / persistence ------------------------------------
+    def seed_rng(self, seed: Optional[int]) -> None:
+        self.rng = np.random.default_rng(seed)
+
+    @property
+    def configuration(self) -> Dict[str, Any]:
+        """Serializable constructor config (for the experiment document)."""
+        name = type(self).__name__.lower()
+        return {name: {k: v for k, v in self._config.items()}}
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"observed": dict(self._observed)}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._observed = dict(state.get("observed", {}))
+
+
+def make_algorithm(space: Space, config: Dict[str, Any]) -> BaseAlgorithm:
+    """Build from ``{"asha": {...}}``-style config (single key = algo name)."""
+    if len(config) != 1:
+        raise ValueError(f"algorithm config must have exactly one key, got {config}")
+    (name, kwargs), = config.items()
+    cls = algo_registry.get(name)
+    return cls(space, **(kwargs or {}))

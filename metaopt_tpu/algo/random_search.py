@@ -1,0 +1,23 @@
+"""Random search.
+
+ref: src/metaopt/algo/random.py — ``space.sample(num, seed)``, stateless
+(SURVEY.md §2.3, BASELINE config 1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry
+from metaopt_tpu.space import Space
+
+
+@algo_registry.register("random")
+class Random(BaseAlgorithm):
+    """Uniform joint sampling from the space priors."""
+
+    def __init__(self, space: Space, seed: Optional[int] = None, **config: Any):
+        super().__init__(space, seed=seed, **config)
+
+    def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
+        return self.space.sample(num, seed=self.rng)

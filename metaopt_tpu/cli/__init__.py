@@ -1,0 +1,14 @@
+"""Command-line interface.
+
+ref: src/metaopt/core/cli/ (SURVEY.md §2.5) — the hunt-style invocation is
+the product's signature UX and is preserved:
+
+    mtpu hunt -n exp ./train.py --lr~'loguniform(1e-5, 1e-1)'
+
+Subcommands: hunt, init-only, insert, status (the lineage's early set plus
+the status reader the lineage grew later; SURVEY.md §5 observability).
+"""
+
+from metaopt_tpu.cli.main import build_parser, main
+
+__all__ = ["main", "build_parser"]

@@ -1,0 +1,250 @@
+"""CLI implementation.
+
+ref: src/metaopt/core/cli/ (SURVEY.md §2.5, §3.1): parse argv → resolve
+config → build space from the user command → configure experiment → workon.
+Everything after the user script path is the script's own command line, with
+``~priors`` marking searchable arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from metaopt_tpu.executor import SubprocessExecutor
+from metaopt_tpu.io.resolve_config import resolve_config
+from metaopt_tpu.ledger import Experiment, Trial
+from metaopt_tpu.ledger.backends import make_ledger
+from metaopt_tpu.space import SpaceBuilder
+from metaopt_tpu.worker import workon
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mtpu",
+        description="TPU-native asynchronous hyperparameter optimization",
+    )
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp):
+        sp.add_argument("-n", "--name", help="experiment name")
+        sp.add_argument("--config", help="framework config YAML")
+        sp.add_argument("--max-trials", type=int, dest="max_trials")
+        sp.add_argument("--pool-size", type=int, dest="pool_size")
+        sp.add_argument(
+            "--ledger",
+            help="ledger spec: 'memory', a directory path, or 'coord://host:port'",
+        )
+
+    hunt = sub.add_parser("hunt", help="run the optimization loop")
+    common(hunt)
+    hunt.add_argument("--worker-trials", type=int, dest="worker_trials")
+    hunt.add_argument("--worker-id", default=None)
+    hunt.add_argument("--exp-max-broken", type=int, default=None,
+                      help="abort after this many broken trials")
+    hunt.add_argument("--working-dir")
+    hunt.add_argument("--n-chips", type=int, default=None,
+                      help="TPU chips per trial (enables the TPU executor)")
+    hunt.add_argument("--timeout-s", type=float, default=None,
+                      help="per-trial wall-clock timeout")
+    hunt.add_argument("cmd", nargs=argparse.REMAINDER,
+                      help="user script and its args with ~priors")
+
+    init = sub.add_parser("init-only", help="create the experiment and exit")
+    common(init)
+    init.add_argument("cmd", nargs=argparse.REMAINDER)
+
+    ins = sub.add_parser("insert", help="manually register a trial")
+    common(ins)
+    ins.add_argument("--params", required=True,
+                     help='JSON dict of param values, e.g. \'{"x": 1.5}\'')
+
+    st = sub.add_parser("status", help="show experiment state")
+    common(st)
+    st.add_argument("--json", action="store_true", dest="as_json")
+
+    return p
+
+
+def _make_ledger_from_spec(spec: Optional[str], cfg: Dict[str, Any]):
+    if spec is None:
+        lcfg = dict(cfg.get("ledger") or {"type": "file"})
+        if lcfg.get("type") == "file" and not lcfg.get("path"):
+            lcfg["path"] = os.path.expanduser("~/.metaopt_tpu/ledger")
+        return make_ledger(lcfg)
+    if spec == "memory":
+        return make_ledger({"type": "memory"})
+    if spec.startswith("coord://"):
+        host, _, port = spec[len("coord://"):].partition(":")
+        return make_ledger({"type": "coord", "host": host, "port": int(port or 0)})
+    return make_ledger({"type": "file", "path": spec})
+
+
+def _strip_remainder(cmd: List[str]) -> List[str]:
+    return cmd[1:] if cmd[:1] == ["--"] else cmd
+
+
+def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
+    user_argv = _strip_remainder(getattr(args, "cmd", []) or [])
+    name = args.name or cfg.get("name")
+    if not name:
+        raise SystemExit("an experiment name is required (-n/--name)")
+    ledger = _make_ledger_from_spec(args.ledger, cfg)
+
+    space = template = None
+    if user_argv:
+        space, template = SpaceBuilder().build(user_argv)
+        if need_cmd and len(space) == 0:
+            raise SystemExit(
+                "no ~priors found in the command; mark searchable args like "
+                "--lr~'loguniform(1e-5, 1e-1)'"
+            )
+    exp = Experiment(
+        name,
+        ledger,
+        space=space,
+        algorithm=cfg.get("algorithm"),
+        max_trials=cfg.get("max_trials", 100),
+        pool_size=cfg.get("pool_size", 1),
+        user_args=user_argv,
+    ).configure()
+    # a joiner (no cmd) reuses the stored user_args to rebuild the template
+    if template is None and exp.user_args:
+        _, template = SpaceBuilder().build(exp.user_args)
+    return exp, template
+
+
+def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
+    exp, template = _experiment_from_args(args, cfg, need_cmd=False)
+    if template is None or not exp.user_args:
+        raise SystemExit("hunt needs a user command (or an experiment that has one)")
+
+    script = template.argv[0] if template.argv else ""
+    interpreter = None
+    if script.endswith(".py") and not os.access(script, os.X_OK):
+        interpreter = [sys.executable]
+
+    n_chips = args.n_chips if args.n_chips is not None else (
+        (cfg.get("executor") or {}).get("n_chips")
+    )
+    if n_chips:
+        from metaopt_tpu.executor.tpu import TPUExecutor
+
+        executor = TPUExecutor(
+            template,
+            n_chips=int(n_chips),
+            working_dir=args.working_dir or cfg.get("working_dir"),
+            interpreter=interpreter,
+            timeout_s=args.timeout_s,
+        )
+    else:
+        executor = SubprocessExecutor(
+            template,
+            working_dir=args.working_dir or cfg.get("working_dir"),
+            interpreter=interpreter,
+            timeout_s=args.timeout_s,
+        )
+
+    worker_id = args.worker_id or f"{os.uname().nodename}-{os.getpid()}"
+    stats = workon(
+        exp,
+        executor,
+        worker_id=worker_id,
+        worker_trials=(
+            args.worker_trials
+            if args.worker_trials is not None
+            else cfg.get("worker_trials")
+        ),
+        max_broken=args.exp_max_broken if args.exp_max_broken is not None else 10,
+        heartbeat_timeout_s=cfg.get("heartbeat_s", 30.0) * 2,
+    )
+    executor.close()
+    s = exp.stats
+    print(json.dumps({
+        "experiment": exp.name,
+        "worker": worker_id,
+        "completed_by_worker": stats.completed,
+        "broken_by_worker": stats.broken,
+        "pruned_by_worker": stats.pruned,
+        "total": s["by_status"],
+        "best": s["best"],
+    }, indent=2))
+    return 0 if s["best"] is not None else 1
+
+
+def _cmd_init_only(args, cfg: Dict[str, Any]) -> int:
+    exp, _ = _experiment_from_args(args, cfg, need_cmd=True)
+    print(f"experiment {exp.name!r} ready: space={exp.space!r} "
+          f"algorithm={exp.algorithm}")
+    return 0
+
+
+def _cmd_insert(args, cfg: Dict[str, Any]) -> int:
+    exp, _ = _experiment_from_args(args, cfg, need_cmd=False)
+    params = json.loads(args.params)
+    if params not in exp.space:
+        raise SystemExit(f"params {params} not inside {exp.space!r}")
+    trial = exp.make_trial(params)
+    kept = exp.register_trials([trial])
+    if not kept:
+        raise SystemExit(f"trial already exists: {trial.id}")
+    print(f"registered trial {trial.id}")
+    return 0
+
+
+def _cmd_status(args, cfg: Dict[str, Any]) -> int:
+    ledger = _make_ledger_from_spec(args.ledger, cfg)
+    names = [args.name] if args.name else ledger.list_experiments()
+    out = []
+    for name in names:
+        doc = ledger.load_experiment(name)
+        if doc is None:
+            raise SystemExit(f"no such experiment: {name}")
+        exp = Experiment(name, ledger).configure()
+        out.append(exp.stats)
+    if args.as_json:
+        print(json.dumps(out, indent=2))
+    else:
+        for s in out:
+            counts = ", ".join(f"{k}:{v}" for k, v in sorted(s["by_status"].items()))
+            print(f"{s['name']}: {s['trials']}/{s['max_trials']} trials ({counts})")
+            if s["best"]:
+                print(f"  best objective {s['best']['objective']:.6g} "
+                      f"at {s['best']['params']}")
+    return 0
+
+
+_COMMANDS = {
+    "hunt": _cmd_hunt,
+    "init-only": _cmd_init_only,
+    "insert": _cmd_insert,
+    "status": _cmd_status,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    level = [logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
+    logging.basicConfig(
+        level=level, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    cfg = resolve_config(
+        {
+            "name": getattr(args, "name", None),
+            "max_trials": getattr(args, "max_trials", None),
+            "pool_size": getattr(args, "pool_size", None),
+        },
+        getattr(args, "config", None),
+    )
+    try:
+        return _COMMANDS[args.command](args, cfg)
+    except KeyboardInterrupt:
+        print("interrupted", file=sys.stderr)
+        return 130

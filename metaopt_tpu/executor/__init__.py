@@ -1,0 +1,25 @@
+"""Trial executors: how a reserved trial actually runs.
+
+ref: src/metaopt/core/worker/consumer.py (SURVEY.md §2.1) — the reference
+materializes hyperparameters into the user's command line / config file,
+subprocesses the script, and reads back the results JSON. Executors here:
+
+- :class:`InProcessExecutor` — objective is a Python callable (tests,
+  benchmarks, BASELINE config 1's CPU-only Rosenbrock),
+- :class:`SubprocessExecutor` — full reference-parity black-box protocol
+  (argv/config materialization + report_results handshake + heartbeats +
+  the ``judge`` early-stop poll over ``report_partial`` streams),
+- :class:`TPUExecutor` (:mod:`metaopt_tpu.executor.tpu`) — subprocess
+  execution with chip / ICI-sub-slice pinning and gang scheduling.
+"""
+
+from metaopt_tpu.executor.base import ExecutionResult, Executor
+from metaopt_tpu.executor.inprocess import InProcessExecutor
+from metaopt_tpu.executor.subproc import SubprocessExecutor
+
+__all__ = [
+    "Executor",
+    "ExecutionResult",
+    "InProcessExecutor",
+    "SubprocessExecutor",
+]

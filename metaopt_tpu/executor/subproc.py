@@ -1,0 +1,222 @@
+"""Subprocess executor: the reference-parity black-box protocol.
+
+ref: src/metaopt/core/worker/consumer.py (SURVEY.md §2.1, §3.1) — materialize
+params into the user's argv (and config file template if present), launch the
+script as a subprocess, wait, read the results JSON written via
+``client.report_results``. Non-zero exit → broken; SIGINT → interrupted.
+
+TPU-era additions beyond the reference:
+
+- heartbeat callbacks while waiting (the lineage's pacemaker, built in),
+- the ``judge`` poll: streams ``client.report_partial`` lines to the
+  algorithm's early-stop hook and terminates pruned trials,
+- env injection (``METAOPT_TPU_RESULTS_PATH``, ``METAOPT_TPU_TRIAL_INFO``,
+  plus any executor extras such as chip pinning from the TPU executor).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from metaopt_tpu.client import RESULTS_PATH_ENV, TRIAL_INFO_ENV
+from metaopt_tpu.executor.base import ExecutionResult, Executor, HeartbeatFn, JudgeFn
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space.builder import CommandTemplate
+
+
+class SubprocessExecutor(Executor):
+    def __init__(
+        self,
+        template: CommandTemplate,
+        working_dir: Optional[str] = None,
+        interpreter: Optional[List[str]] = None,
+        poll_interval_s: float = 0.2,
+        heartbeat_every_s: float = 5.0,
+        timeout_s: Optional[float] = None,
+        extra_env: Optional[Dict[str, str]] = None,
+    ):
+        self.template = template
+        self.working_dir = working_dir
+        self.interpreter = interpreter  # e.g. [sys.executable]; None = direct exec
+        self.poll_interval_s = poll_interval_s
+        self.heartbeat_every_s = heartbeat_every_s
+        self.timeout_s = timeout_s
+        self.extra_env = dict(extra_env or {})
+
+    # -- env/argv assembly -------------------------------------------------
+    def _prepare(self, trial: Trial, tmpdir: str) -> tuple[List[str], Dict[str, str], str]:
+        results_path = os.path.join(tmpdir, "results.json")
+        config_out = None
+        if self.template.config_template is not None:
+            ext = os.path.splitext(self.template.config_path or "c.yaml")[1]
+            config_out = os.path.join(tmpdir, f"trial_config{ext}")
+            self.template.materialize_config(trial.params, config_out)
+        argv = self.template.format(trial.params, config_out=config_out)
+        if self.interpreter:
+            argv = list(self.interpreter) + argv
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update(trial.resources.get("env", {}))
+        # the trial process must be able to import metaopt_tpu.client even
+        # when the framework runs from a source tree rather than site-packages
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+        parts = env.get("PYTHONPATH", "").split(os.pathsep)
+        if pkg_root not in parts:
+            env["PYTHONPATH"] = os.pathsep.join([pkg_root] + [p for p in parts if p])
+        env[RESULTS_PATH_ENV] = results_path
+        env[TRIAL_INFO_ENV] = json.dumps(
+            {
+                "id": trial.id,
+                "experiment": trial.experiment,
+                "params": trial.params,
+                "resources": {k: v for k, v in trial.resources.items() if k != "env"},
+            }
+        )
+        return argv, env, results_path
+
+    @staticmethod
+    def _read_partial(path: str, already: int) -> List[Dict[str, Any]]:
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except FileNotFoundError:
+            return []
+        out = []
+        for line in lines[already:]:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # torn tail write; picked up next poll
+        return out
+
+    # -- main --------------------------------------------------------------
+    def execute(
+        self,
+        trial: Trial,
+        heartbeat: Optional[HeartbeatFn] = None,
+        judge: Optional[JudgeFn] = None,
+    ) -> ExecutionResult:
+        with tempfile.TemporaryDirectory(prefix="mtpu_trial_") as tmpdir:
+            argv, env, results_path = self._prepare(trial, tmpdir)
+            # stdout/stderr go to files, not PIPEs: an undrained PIPE deadlocks
+            # a chatty script once the ~64KB buffer fills
+            stdout_path = os.path.join(tmpdir, "stdout")
+            stderr_path = os.path.join(tmpdir, "stderr")
+            try:
+                with open(stdout_path, "wb") as so, open(stderr_path, "wb") as se:
+                    proc = subprocess.Popen(
+                        argv,
+                        env=env,
+                        cwd=self.working_dir,
+                        stdout=so,
+                        stderr=se,
+                        start_new_session=True,  # isolate signals (we kill the group)
+                    )
+            except OSError as e:
+                return ExecutionResult("broken", note=f"spawn failed: {e}")
+
+            partial: List[Dict[str, Any]] = []
+            started = time.time()
+            last_beat = started
+            pruned = False
+            try:
+                while True:
+                    rc = proc.poll()
+                    if rc is not None:
+                        break
+                    now = time.time()
+                    if self.timeout_s and now - started > self.timeout_s:
+                        self._kill(proc)
+                        return ExecutionResult(
+                            "broken", note=f"timeout after {self.timeout_s}s"
+                        )
+                    if heartbeat and now - last_beat >= self.heartbeat_every_s:
+                        last_beat = now
+                        if not heartbeat():
+                            self._kill(proc)
+                            return ExecutionResult(
+                                "interrupted", note="lost reservation"
+                            )
+                    new = self._read_partial(results_path + ".partial", len(partial))
+                    if new:
+                        partial.extend(new)
+                        if judge:
+                            decision = judge(trial, partial)
+                            if decision and decision.get("stop"):
+                                pruned = True
+                                self._kill(proc)
+                                proc.wait()
+                                break
+                    time.sleep(self.poll_interval_s)
+            except KeyboardInterrupt:
+                self._kill(proc)
+                proc.wait()
+                return ExecutionResult("interrupted", note="SIGINT")
+
+            rc = proc.returncode if not pruned else 0
+            results = self._collect(results_path, partial, pruned)
+            if results is None:
+                try:
+                    with open(stderr_path, "rb") as f:
+                        stderr_tail = f.read()[-2000:]
+                except OSError:
+                    stderr_tail = b""
+                return ExecutionResult(
+                    "broken",
+                    exit_code=rc,
+                    note=(
+                        f"exit={rc}, no results reported; stderr tail: "
+                        f"{stderr_tail.decode(errors='replace')}"
+                    ),
+                )
+            if rc != 0:
+                return ExecutionResult(
+                    "broken", exit_code=rc, note=f"non-zero exit {rc}"
+                )
+            note = "pruned by judge" if pruned else ""
+            return ExecutionResult("completed", results=results, exit_code=rc, note=note)
+
+    @staticmethod
+    def _kill(proc: subprocess.Popen) -> None:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    @staticmethod
+    def _collect(
+        results_path: str, partial: List[Dict[str, Any]], pruned: bool
+    ) -> Optional[List[Dict[str, Any]]]:
+        """Final results file wins; a pruned trial falls back to its last
+
+        partial objective (the rung's measurement, per ASHA semantics).
+        """
+        try:
+            with open(results_path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            pass
+        if partial:
+            last = partial[-1]
+            return [
+                {
+                    "name": "objective",
+                    "type": "objective",
+                    "value": float(last["objective"]),
+                },
+                {
+                    "name": "pruned_at_step" if pruned else "last_step",
+                    "type": "statistic",
+                    "value": int(last.get("step", -1)),
+                },
+            ]
+        return None

@@ -50,10 +50,18 @@ def _set_path(cfg: Dict[str, Any], dotted: str, value: Any) -> None:
     node[leaf] = value
 
 
+#: keys whose dict value REPLACES the lower layer instead of deep-merging —
+#: an algorithm choice is atomic ({"asha": ...} must not union with the
+#: default {"random": ...} into a two-key config)
+_REPLACE_KEYS = {"algorithm"}
+
+
 def _merge(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
     out = copy.deepcopy(base)
     for k, v in overlay.items():
-        if isinstance(v, dict) and isinstance(out.get(k), dict):
+        if k in _REPLACE_KEYS and v is not None:
+            out[k] = copy.deepcopy(v)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
             out[k] = _merge(out[k], v)
         elif v is not None:
             out[k] = v

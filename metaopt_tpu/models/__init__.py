@@ -1,0 +1,22 @@
+"""Demo model zoo for the BASELINE benchmark configs.
+
+The reference ships no models — the user script is opaque (SURVEY.md §0).
+These exist so the five BASELINE configs are runnable end-to-end on TPU:
+
+- :mod:`objectives` — CPU-only closed-form objectives (Rosenbrock; config 1)
+- :mod:`mlp`         — MLP/MNIST-shaped, 4 hparams, single chip (config 2)
+- :mod:`resnet`      — ResNet-50/CIFAR-shaped, multi-fidelity (config 3)
+- :mod:`transformer` — Transformer-base, 4-chip sub-slice pjit (config 4)
+- :mod:`ppo`         — PPO actor-critic populations (config 5)
+
+All use synthetic data generated on device (zero-egress environment — no
+dataset downloads), bfloat16 matmuls for the MXU, donated buffers, and
+jit-compiled train steps; batches and shapes are static so XLA compiles one
+program per trial. Each module exposes ``make_objective(**fixed)`` returning
+a callable usable with InProcessExecutor, and the hunt-able scripts live in
+examples/.
+"""
+
+from metaopt_tpu.models import objectives
+
+__all__ = ["objectives"]

@@ -1,0 +1,15 @@
+"""Device-side numerical kernels (jit/vmap JAX, Pallas where it pays).
+
+The reference has no native/kernel layer at all (SURVEY.md §2.9 — pure
+Python over numpy/scipy); on TPU the performant-native role is played by
+XLA-compiled JAX. Hot paths live here so algorithm modules stay host-side
+control plane:
+
+- :mod:`tpe_math` — truncated-Gaussian Parzen mixtures + EI scoring for TPE
+  (the BASELINE north star: flat suggest() latency past 10k observations via
+  power-of-two padding, so XLA compiles O(log n) kernel variants total).
+"""
+
+from metaopt_tpu.ops.tpe_math import adaptive_bandwidths, ei_scores, pad_pow2
+
+__all__ = ["adaptive_bandwidths", "ei_scores", "pad_pow2"]

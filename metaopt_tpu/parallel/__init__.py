@@ -1,0 +1,35 @@
+"""Mesh / sharding helpers for trial workloads.
+
+The reference has no parallelism layer (SURVEY.md §2.8 — the user script owns
+all model sharding); what the TPU build owes instead is *sub-slice* support:
+a trial is handed an ICI-contiguous block of chips (``MTPU_ASSIGNED_CHIPS``)
+and whatever model runs inside shards over exactly those chips with plain
+``jax.sharding``. These helpers are that contract:
+
+- :func:`trial_devices` — the JAX devices this trial may touch,
+- :func:`make_mesh` — dp/tp (or custom) meshes over those devices,
+- :func:`shard_batch` / :func:`replicate` — canonical data/param placement,
+- :func:`logical_axis_rules` style param specs for the demo model zoo.
+"""
+
+from metaopt_tpu.parallel.mesh import (
+    make_mesh,
+    trial_devices,
+    trial_mesh,
+)
+from metaopt_tpu.parallel.sharding import (
+    batch_spec,
+    replicate,
+    shard_batch,
+    shard_params,
+)
+
+__all__ = [
+    "trial_devices",
+    "make_mesh",
+    "trial_mesh",
+    "shard_batch",
+    "replicate",
+    "batch_spec",
+    "shard_params",
+]

@@ -1,0 +1,74 @@
+"""Device meshes over a trial's assigned sub-slice."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def trial_devices() -> List[jax.Device]:
+    """The devices this trial process may use.
+
+    The TPU executor pins trials via ``MTPU_ASSIGNED_CHIPS`` (see
+    executor/topology.py). When the runtime actually hides other chips
+    (TPU_VISIBLE_CHIPS honored by the plugin) the id list matches
+    ``jax.devices()`` directly; when it doesn't (CPU test meshes), the ids
+    index into the visible device list — both cases resolve here.
+    """
+    devices = jax.devices()
+    spec = os.environ.get("MTPU_ASSIGNED_CHIPS")
+    if not spec:
+        return list(devices)
+    want = [int(s) for s in spec.split(",") if s != ""]
+    by_id = {d.id: d for d in devices}
+    if all(i in by_id for i in want):
+        picked = [by_id[i] for i in want]
+    else:  # ids are slice-relative; index into the visible list
+        picked = [devices[i % len(devices)] for i in want]
+    # a pinned runtime that already hides other chips needs no filtering
+    return picked or list(devices)
+
+
+def make_mesh(
+    axes: Sequence[Tuple[str, int]],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """``make_mesh([("dp", 2), ("tp", 4)])`` → a 2×4 Mesh.
+
+    Axis sizes must multiply to the device count; a size of -1 means "fill
+    with whatever remains" (at most one axis).
+    """
+    devs = list(devices if devices is not None else trial_devices())
+    names = [a for a, _ in axes]
+    sizes = [int(s) for _, s in axes]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if len(devs) % known:
+            raise ValueError(
+                f"{len(devs)} devices not divisible by fixed axes {known}"
+            )
+        sizes[sizes.index(-1)] = len(devs) // known
+    if int(np.prod(sizes)) != len(devs):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {int(np.prod(sizes))} "
+            f"devices, have {len(devs)}"
+        )
+    grid = np.asarray(devs, dtype=object).reshape(sizes)
+    return Mesh(grid, tuple(names))
+
+
+def trial_mesh(tp: int = 1, extra_axes: Sequence[Tuple[str, int]] = ()) -> Mesh:
+    """The canonical trial mesh: data-parallel over the sub-slice, with an
+
+    optional tensor-parallel inner axis — ``trial_mesh(tp=2)`` on a 4-chip
+    sub-slice gives a ("dp", 2) × ("tp", 2) mesh. Demo-zoo models default to
+    pure dp, matching SURVEY.md §2.8's "plain pjit data-parallel" scope.
+    """
+    axes = [("dp", -1), ("tp", tp), *extra_axes]
+    return make_mesh(axes)

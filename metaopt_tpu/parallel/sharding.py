@@ -1,0 +1,45 @@
+"""Sharding placement helpers used by the demo model zoo."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Batch-sharded over the dp axis (leading dim), replicated elsewhere."""
+    return P("dp") if "dp" in mesh.axis_names else P()
+
+
+def shard_batch(mesh: Mesh, batch: Any) -> Any:
+    """Place a host batch pytree onto the mesh, batch dim over dp."""
+    sharding = NamedSharding(mesh, batch_spec(mesh))
+    return jax.device_put(batch, sharding)
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    """Fully replicate a pytree (params/opt state for pure-dp demos)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def shard_params(
+    mesh: Mesh,
+    params: Any,
+    rule: Optional[Callable[[tuple, jax.Array], P]] = None,
+) -> Any:
+    """Place params by rule(path, leaf) → PartitionSpec; default replicate.
+
+    Model files provide tp-aware rules (e.g. attention heads over "tp");
+    anything the rule declines (returns None) is replicated.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    placed = []
+    for path, leaf in flat:
+        spec = rule(path, leaf) if rule else None
+        placed.append(
+            jax.device_put(leaf, NamedSharding(mesh, spec if spec is not None else P()))
+        )
+    return jax.tree_util.tree_unflatten(treedef, placed)

@@ -1,0 +1,18 @@
+"""The worker runtime: Producer + the workon loop.
+
+ref: src/metaopt/core/worker/ (SURVEY.md §2.1, §3.1) — the hot loop:
+
+    while not experiment.is_done:
+        producer.produce()          # observe -> suggest -> register
+        trial = reserve_trial()     # atomic CAS on the ledger
+        consume(trial)              # executor runs it; results pushed back
+
+Any number of workon loops (threads, processes, hosts) may run against one
+ledger; the reserve CAS is the only synchronization point, exactly like the
+reference's Mongo ``find_one_and_update`` story.
+"""
+
+from metaopt_tpu.worker.producer import Producer
+from metaopt_tpu.worker.loop import WorkerStats, workon
+
+__all__ = ["Producer", "workon", "WorkerStats"]

@@ -1,0 +1,143 @@
+"""workon: the worker main loop.
+
+ref: src/metaopt/core/worker/__init__.py (SURVEY.md §2.1): produce → reserve
+→ consume until the experiment is done; KeyboardInterrupt marks the in-flight
+trial interrupted. Additions over the reference: stale-reservation release
+each cycle (pacemaker doctrine), per-worker trial caps (``worker_trials``),
+idle backoff when the algorithm is barrier-blocked (Hyperband rung waits),
+and the judge/early-stop wiring into the executor.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from metaopt_tpu.algo.base import BaseAlgorithm, make_algorithm
+from metaopt_tpu.executor.base import Executor
+from metaopt_tpu.ledger.experiment import Experiment
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.worker.producer import Producer
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerStats:
+    reserved: int = 0
+    completed: int = 0
+    broken: int = 0
+    interrupted: int = 0
+    pruned: int = 0
+    idle_cycles: int = 0
+    events: List[Dict[str, Any]] = field(default_factory=list)
+
+
+def workon(
+    experiment: Experiment,
+    executor: Executor,
+    worker_id: str = "worker-0",
+    algorithm: Optional[BaseAlgorithm] = None,
+    worker_trials: Optional[int] = None,
+    max_broken: Optional[int] = 10,
+    heartbeat_timeout_s: float = 60.0,
+    idle_sleep_s: float = 0.05,
+    max_idle_cycles: int = 200,
+) -> WorkerStats:
+    """Run trials until the experiment finishes (or this worker's cap hits).
+
+    ``max_broken`` (the reference's worker guard) stops this worker once that
+    many trials have broken — a persistently-crashing user script must not
+    spin the produce→break loop forever.
+    """
+    algo = algorithm or make_algorithm(experiment.space, experiment.algorithm)
+    producer = Producer(experiment, algo)
+    stats = WorkerStats()
+
+    def heartbeat_for(trial: Trial):
+        def beat() -> bool:
+            return experiment.ledger.heartbeat(experiment.name, trial.id, worker_id)
+        return beat
+
+    def judge_fn(trial: Trial, partial: List[Dict[str, Any]]):
+        return algo.judge(trial, partial)
+
+    while not experiment.is_done:
+        if worker_trials is not None and stats.reserved >= worker_trials:
+            log.info("%s: worker_trials cap (%d) reached", worker_id, worker_trials)
+            break
+        if max_broken is not None and stats.broken >= max_broken:
+            log.error(
+                "%s: %d trials broke (max_broken=%d) — is the user script "
+                "runnable? Stopping.", worker_id, stats.broken, max_broken,
+            )
+            break
+
+        experiment.ledger.release_stale(experiment.name, heartbeat_timeout_s)
+        produced = producer.produce()
+        trial = experiment.reserve_trial(worker_id)
+
+        if trial is None:
+            # nothing to run: either in-flight trials elsewhere, an algorithm
+            # barrier (sync rungs / generation waits), or true exhaustion
+            in_flight = experiment.count("reserved")
+            if produced == 0 and in_flight == 0:
+                stats.idle_cycles += 1
+                if algo.is_done or stats.idle_cycles > max_idle_cycles:
+                    log.info("%s: no work producible; stopping", worker_id)
+                    break
+            else:
+                stats.idle_cycles = 0
+            time.sleep(idle_sleep_s)
+            continue
+
+        stats.idle_cycles = 0
+        stats.reserved += 1
+        log.debug("%s running trial %s %s", worker_id, trial.id[:8], trial.params)
+        t0 = time.time()
+        try:
+            res = executor.execute(
+                trial, heartbeat=heartbeat_for(trial), judge=judge_fn
+            )
+        except KeyboardInterrupt:
+            trial.transition("interrupted")
+            experiment.ledger.update_trial(
+                trial, expected_status="reserved", expected_worker=worker_id
+            )
+            stats.interrupted += 1
+            raise
+
+        trial.exit_code = res.exit_code
+        if res.status == "completed":
+            ok = experiment.push_results(trial, res.results)
+            if ok:
+                stats.completed += 1
+                if "pruned" in res.note:
+                    stats.pruned += 1
+            else:
+                log.warning(
+                    "%s lost reservation of %s before result push", worker_id, trial.id
+                )
+        else:
+            trial.transition(res.status)
+            experiment.ledger.update_trial(
+                trial, expected_status="reserved", expected_worker=worker_id
+            )
+            stats.broken += res.status == "broken"
+            stats.interrupted += res.status == "interrupted"
+            if res.note:
+                log.info("trial %s %s: %s", trial.id[:8], res.status, res.note)
+        stats.events.append(
+            {
+                "trial": trial.id,
+                "status": res.status,
+                "runtime_s": round(time.time() - t0, 4),
+                "note": res.note,
+            }
+        )
+
+    # final observe so the algorithm state is current for callers
+    algo.observe(experiment.fetch_completed_trials())
+    return stats

@@ -1,0 +1,51 @@
+"""Producer: the algorithm-facing pump.
+
+ref: src/metaopt/core/worker/producer.py (SURVEY.md §2.1): fetch completed
+trials → ``algo.observe()`` → ``algo.suggest(pool_size)`` → register (the
+ledger's duplicate detection absorbs suggestion races between workers).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from metaopt_tpu.algo.base import BaseAlgorithm
+from metaopt_tpu.ledger.experiment import Experiment
+
+log = logging.getLogger(__name__)
+
+
+class Producer:
+    def __init__(self, experiment: Experiment, algorithm: BaseAlgorithm):
+        self.experiment = experiment
+        self.algorithm = algorithm
+
+    def produce(self, pool_size: Optional[int] = None) -> int:
+        """One observe→suggest→register cycle; returns #trials registered."""
+        exp = self.experiment
+        self.algorithm.observe(exp.fetch_completed_trials())
+
+        if self.algorithm.is_done:
+            exp.mark_algo_done()
+            return 0
+
+        # don't flood the ledger past max_trials with pending work
+        pending = exp.count(("new", "reserved"))
+        completed = exp.count("completed")
+        budget_left = exp.max_trials - completed - pending
+        want = min(pool_size or exp.pool_size, max(0, budget_left))
+        if want <= 0:
+            return 0
+
+        points = self.algorithm.suggest(want)
+        if not points:
+            return 0
+        trials = [exp.make_trial(p) for p in points]
+        kept = exp.register_trials(trials)
+        if len(kept) < len(trials):
+            log.debug(
+                "producer: %d/%d suggestions were duplicates",
+                len(trials) - len(kept), len(trials),
+            )
+        return len(kept)

@@ -17,6 +17,13 @@ if "--xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# The axon sitecustomize imports jax at interpreter startup — before this
+# conftest runs — so the env vars above are snapshotted too late. Re-apply
+# through the live config (safe: no backend has been initialized yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,62 @@
+"""DumbAlgo: scripted-suggestion test double.
+
+ref: the lineage's DumbAlgo conftest mock (SURVEY.md §4) — exercises
+Producer/Experiment logic without a real optimizer.
+"""
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space import Space
+
+
+@algo_registry.register("dumbalgo")
+class DumbAlgo(BaseAlgorithm):
+    """Returns pre-scripted points; records every observe call."""
+
+    def __init__(
+        self,
+        space: Space,
+        seed: Optional[int] = None,
+        value: Optional[Dict[str, Any]] = None,
+        scripted: Optional[List[Dict[str, Any]]] = None,
+        done_after: Optional[int] = None,
+        judge_stop_below: Optional[float] = None,
+        **config: Any,
+    ):
+        super().__init__(space, seed=seed, **config)
+        self.value = value
+        self.scripted = list(scripted or [])
+        self.done_after = done_after
+        self.judge_stop_below = judge_stop_below
+        self.suggest_calls: List[int] = []
+        self.observed_trials: List[Trial] = []
+
+    def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
+        self.suggest_calls.append(num)
+        out = []
+        for _ in range(num):
+            if self.scripted:
+                out.append(self.scripted.pop(0))
+            elif self.value is not None:
+                out.append(dict(self.value))
+            else:
+                out.extend(self.space.sample(1, seed=self.rng))
+        return out
+
+    def _observe_one(self, trial: Trial) -> None:
+        self.observed_trials.append(trial)
+
+    def judge(self, trial, partial):
+        if self.judge_stop_below is None or not partial:
+            return None
+        if partial[-1]["objective"] < self.judge_stop_below:
+            return {"stop": True}
+        return None
+
+    @property
+    def is_done(self) -> bool:
+        if self.done_after is not None:
+            return self.n_observed >= self.done_after
+        return super().is_done

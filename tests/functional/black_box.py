@@ -1,0 +1,25 @@
+"""The functional-test user script: a 1-D quadratic.
+
+ref: tests/functional/demo/black_box.py in the lineage (SURVEY.md §4) — the
+canonical opaque script run through the real CLI.
+"""
+
+import argparse
+
+from metaopt_tpu.client import report_results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-x", type=float, required=True)
+    p.add_argument("--fail-above", type=float, default=None)
+    args = p.parse_args()
+    if args.fail_above is not None and args.x > args.fail_above:
+        raise SystemExit(3)  # deliberately broken trial
+    report_results(
+        [{"name": "objective", "type": "objective", "value": (args.x - 1.0) ** 2}]
+    )
+
+
+if __name__ == "__main__":
+    main()

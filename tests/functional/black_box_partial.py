@@ -1,0 +1,23 @@
+"""User script streaming partial objectives (exercises judge/early-stop)."""
+
+import argparse
+import time
+
+from metaopt_tpu.client import report_partial, report_results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-x", type=float, required=True)
+    p.add_argument("--steps", type=int, default=50)
+    args = p.parse_args()
+    obj = (args.x - 1.0) ** 2
+    for step in range(args.steps):
+        # objective "improves" toward its final value as steps progress
+        report_partial(obj + (args.steps - step - 1) * 0.1, step)
+        time.sleep(0.05)
+    report_results([{"name": "objective", "type": "objective", "value": obj}])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,202 @@
+"""Algorithm unit tests: registry, Random, ASHA promotion rules, Hyperband
+
+bracket table, EvolutionES generations — deterministic seeds, tiny spaces,
+hand-computed expectations (SURVEY.md §4 coverage model).
+"""
+
+import pytest
+
+from metaopt_tpu.algo import ASHA, EvolutionES, Hyperband, Random, make_algorithm
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space import build_space
+
+from tests.dumbalgo import DumbAlgo  # noqa: F401  (registers the plugin)
+
+
+def make_space(fidelity=False):
+    spec = {"x": "uniform(-5, 5)", "opt": "choices(['a', 'b'])"}
+    if fidelity:
+        spec["epochs"] = "fidelity(1, 4, base=2)"
+    return build_space(spec)
+
+
+def completed(params, objective, space):
+    t = Trial(params=params, experiment="e")
+    t.lineage = space.hash_point(params)
+    t.transition("reserved")
+    t.attach_results([{"name": "o", "type": "objective", "value": objective}])
+    t.transition("completed")
+    return t
+
+
+class TestRegistryAndBase:
+    def test_make_algorithm(self):
+        space = make_space()
+        algo = make_algorithm(space, {"random": {"seed": 3}})
+        assert isinstance(algo, Random)
+        with pytest.raises(KeyError):
+            make_algorithm(space, {"nope": {}})
+        with pytest.raises(ValueError):
+            make_algorithm(space, {"random": {}, "tpe": {}})
+
+    def test_observe_idempotent_by_trial_id(self):
+        space = make_space()
+        algo = DumbAlgo(space)
+        t = completed({"x": 1.0, "opt": "a"}, 0.5, space)
+        algo.observe([t])
+        algo.observe([t])
+        assert algo.n_observed == 1
+        assert len(algo.observed_trials) == 1
+
+    def test_fidelity_requirement_enforced(self):
+        with pytest.raises(ValueError):
+            ASHA(make_space(fidelity=False))
+
+
+class TestRandom:
+    def test_deterministic_and_in_space(self):
+        space = make_space()
+        a1 = Random(space, seed=7)
+        a2 = Random(space, seed=7)
+        s1, s2 = a1.suggest(10), a2.suggest(10)
+        assert s1 == s2
+        assert all(p in space for p in s1)
+
+
+class TestASHA:
+    def test_promotion_rule_hand_computed(self):
+        space = make_space(fidelity=True)  # rungs [1, 2, 4], eta=2
+        algo = ASHA(space, seed=0)
+        pts = algo.suggest(4)
+        assert all(p["epochs"] == 1 for p in pts)  # all enter bottom rung
+        # complete them with objectives 0.1 < 0.2 < 0.3 < 0.4
+        for i, p in enumerate(pts):
+            algo.observe([completed(p, (i + 1) / 10, space)])
+        # 4 results at rung 0, eta=2 → top-2 promotable; best first
+        nxt = algo.suggest(1)[0]
+        assert nxt["epochs"] == 2
+        assert nxt["x"] == pts[0]["x"]  # the objective-0.1 point
+        nxt2 = algo.suggest(1)[0]
+        assert nxt2["epochs"] == 2 and nxt2["x"] == pts[1]["x"]
+        # no third promotion: next suggestion is a fresh bottom-rung point
+        nxt3 = algo.suggest(1)[0]
+        assert nxt3["epochs"] == 1
+
+    def test_promotion_to_top_rung(self):
+        space = make_space(fidelity=True)  # rungs [1, 2, 4], eta=2
+        algo = ASHA(space, seed=0)
+        pts = algo.suggest(4)
+        for i, p in enumerate(pts):
+            algo.observe([completed(p, i / 10, space)])
+        # rung0 k = 4//2 = 2 → two promotions to budget 2
+        promo0 = algo.suggest(1)[0]
+        assert promo0["epochs"] == 2 and promo0["x"] == pts[0]["x"]
+        algo.observe([completed(promo0, 0.05, space)])
+        # rung1 has 1 result → 1//2 == 0 → next promotion still from rung0
+        promo1 = algo.suggest(1)[0]
+        assert promo1["epochs"] == 2 and promo1["x"] == pts[1]["x"]
+        algo.observe([completed(promo1, 0.06, space)])
+        # rung1 now has 2 results → k=1 → best (pts[0] lineage) → budget 4
+        top = algo.suggest(1)[0]
+        assert top["epochs"] == 4 and top["x"] == pts[0]["x"]
+
+    def test_rung_table_and_state_roundtrip(self):
+        space = make_space(fidelity=True)
+        algo = ASHA(space, seed=0)
+        pts = algo.suggest(2)
+        for p in pts:
+            algo.observe([completed(p, 0.1, space)])
+        state = algo.state_dict()
+        algo2 = ASHA(space, seed=0)
+        algo2.load_state_dict(state)
+        assert algo2.rung_table == algo.rung_table
+
+
+class TestHyperband:
+    def test_bracket_table_hand_computed(self):
+        # budgets [1,2,4], eta=2, s_max=2:
+        #  bracket s=2: n0=ceil(3/3*4)=4, rungs capacities [4,2,1] @ budgets [1,2,4]
+        #  bracket s=1: n0=ceil(3/2*2)=3, capacities [3,1] @ budgets [2,4]
+        #  bracket s=0: n0=ceil(3/1*1)=3, capacities [3] @ budgets [4]
+        space = make_space(fidelity=True)
+        algo = Hyperband(space, seed=0)
+        caps = [[r.capacity for r in b.rungs] for b in algo.brackets]
+        buds = [[r.budget for r in b.rungs] for b in algo.brackets]
+        assert caps == [[4, 2, 1], [3, 1], [3]]
+        assert buds == [[1, 2, 4], [2, 4], [4]]
+
+    def test_successive_halving_barrier(self):
+        space = make_space(fidelity=True)
+        algo = Hyperband(space, seed=0, repetitions=1)
+        # fill bracket 0's base rung (4 trials at budget 1)
+        first = algo.suggest(4)
+        assert [p["epochs"] for p in first] == [1, 1, 1, 1]
+        # barrier: bracket 0 can't promote until all 4 complete; brackets 1-2 fill
+        more = algo.suggest(10)
+        assert all(p["epochs"] in (2, 4) for p in more)
+        assert len(more) == 6  # 3 @ budget 2 (bracket 1) + 3 @ budget 4 (bracket 2)
+        # nothing left to issue while results pending
+        assert algo.suggest(5) == []
+        # complete bracket 0's base rung → top-2 promote to budget 2
+        for i, p in enumerate(first):
+            algo.observe([completed(p, i / 10, space)])
+        promos = algo.suggest(5)
+        assert len(promos) == 2
+        assert all(p["epochs"] == 2 for p in promos)
+        assert {p["x"] for p in promos} == {first[0]["x"], first[1]["x"]}
+
+    def test_is_done_after_repetitions(self):
+        space = make_space(fidelity=True)
+        algo = Hyperband(space, seed=0, repetitions=1)
+        guard = 0
+        while not algo.is_done and guard < 200:
+            guard += 1
+            pts = algo.suggest(3)
+            if not pts:
+                break
+            for p in pts:
+                algo.observe([completed(p, float(abs(p["x"])), space)])
+        assert algo.is_done
+
+
+class TestEvolutionES:
+    def test_generations_and_budget_ramp(self):
+        space = make_space(fidelity=True)
+        algo = EvolutionES(space, seed=0, population_size=4, max_generations=3)
+        gen0 = algo.suggest(10)
+        assert len(gen0) == 4               # population barrier
+        assert all(p["epochs"] == 1 for p in gen0)
+        for i, p in enumerate(gen0):
+            algo.observe([completed(p, i / 10, space)])
+        gen1 = algo.suggest(10)
+        assert len(gen1) == 4
+        assert all(p["epochs"] == 2 for p in gen1)  # budget ramped up a rung
+        assert algo.generation == 1
+        assert all(p in space for p in gen1)
+
+    def test_survivor_bias(self):
+        # survivors of gen0 seed gen1 points near the best x values
+        space = build_space({"x": "uniform(0, 1)", "epochs": "fidelity(1, 2, base=2)"})
+        algo = EvolutionES(space, seed=1, population_size=6, mutate_prob=1.0,
+                           mutate_scale=0.01)
+        gen0 = algo.suggest(6)
+        # make low x good
+        for p in gen0:
+            algo.observe([completed(p, p["x"], space)])
+        best3 = sorted(p["x"] for p in gen0)[:3]
+        gen1 = algo.suggest(6)
+        assert algo.generation == 1
+        for p in gen1:
+            assert min(abs(p["x"] - b) for b in best3) < 0.1
+
+    def test_state_roundtrip(self):
+        space = make_space(fidelity=True)
+        algo = EvolutionES(space, seed=0, population_size=4)
+        pts = algo.suggest(4)
+        for p in pts:
+            algo.observe([completed(p, 0.3, space)])
+        algo.suggest(1)
+        algo2 = EvolutionES(space, seed=0, population_size=4)
+        algo2.load_state_dict(algo.state_dict())
+        assert algo2.generation == algo.generation
+        assert algo2._survivors == algo._survivors

@@ -1,0 +1,49 @@
+"""Mesh/sharding helpers on the virtual 8-device CPU mesh."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from metaopt_tpu.parallel import make_mesh, shard_batch, trial_devices, trial_mesh
+
+
+def test_virtual_mesh_has_8_devices():
+    assert len(jax.devices()) == 8  # conftest forces the CPU mesh
+
+
+def test_make_mesh_shapes():
+    m = make_mesh([("dp", 2), ("tp", 4)])
+    assert m.shape == {"dp": 2, "tp": 4}
+    m = make_mesh([("dp", -1), ("tp", 2)])
+    assert m.shape == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        make_mesh([("dp", 3), ("tp", 2)])
+    with pytest.raises(ValueError):
+        make_mesh([("dp", -1), ("tp", -1)])
+
+
+def test_trial_devices_respects_assignment(monkeypatch):
+    monkeypatch.setenv("MTPU_ASSIGNED_CHIPS", "0,1,2,3")
+    devs = trial_devices()
+    assert [d.id for d in devs] == [0, 1, 2, 3]
+    monkeypatch.delenv("MTPU_ASSIGNED_CHIPS")
+    assert len(trial_devices()) == 8
+
+
+def test_trial_mesh_over_subslice(monkeypatch):
+    monkeypatch.setenv("MTPU_ASSIGNED_CHIPS", "4,5,6,7")
+    m = trial_mesh(tp=2)
+    assert m.shape == {"dp": 2, "tp": 2}
+    assert {d.id for d in m.devices.flat} == {4, 5, 6, 7}
+
+
+def test_shard_batch_places_on_dp():
+    m = make_mesh([("dp", 4), ("tp", 2)])
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    with m:
+        sx = shard_batch(m, x)
+    assert sx.sharding.spec == P("dp")
+    np.testing.assert_array_equal(np.asarray(sx), x)

@@ -1,0 +1,127 @@
+"""TPE unit tests: split math, weight ramp, EI kernel sanity, convergence.
+
+ref coverage model: the lineage's TPE unit tests (SURVEY.md §4) — hand-checked
+split indices and deterministic convergence on a tiny quadratic.
+"""
+
+import numpy as np
+import pytest
+
+from metaopt_tpu.algo import TPE, Random
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.ops.tpe_math import adaptive_bandwidths, pad_pow2
+from metaopt_tpu.space import build_space
+
+
+def make_tpe(seed=0, **kw):
+    space = build_space({"x": "uniform(-10, 10)", "c": "choices(['a', 'b', 'c'])"})
+    return space, TPE(space, seed=seed, n_initial_points=5, **kw)
+
+
+def completed(space, params, objective):
+    t = Trial(params=params, experiment="e")
+    t.lineage = space.hash_point(params)
+    t.transition("reserved")
+    t.attach_results([{"name": "o", "type": "objective", "value": objective}])
+    t.transition("completed")
+    return t
+
+
+class TestInternals:
+    def test_pad_pow2(self):
+        assert pad_pow2(1) == 8
+        assert pad_pow2(8) == 8
+        assert pad_pow2(9) == 16
+        assert pad_pow2(1000) == 1024
+
+    def test_adaptive_bandwidths(self):
+        mu = np.array([0.1, 0.5, 0.9])
+        sig = adaptive_bandwidths(mu)
+        # middle point: max(gap_left, gap_right) = 0.4; edges include bound gap
+        assert sig[1] == pytest.approx(0.4)
+        assert sig[0] == pytest.approx(0.4)  # max(0.1-0, 0.5-0.1)
+        assert len(adaptive_bandwidths(np.array([0.5]))) == 1
+
+    def test_split_gamma(self):
+        space, tpe = make_tpe(gamma=0.25)
+        for i in range(8):
+            tpe.observe([completed(space, {"x": float(i), "c": "a"}, float(i))])
+        below, above = tpe._split()
+        assert len(below) == 2  # ceil(0.25 * 8)
+        assert sorted(tpe._y[i] for i in below) == [0.0, 1.0]
+
+    def test_weight_ramp(self):
+        space, tpe = make_tpe(full_weight_num=3)
+        w = tpe._weights(5)
+        assert len(w) == 5
+        assert np.all(w[-3:] == 1.0)
+        # the older points ramp linearly from 1/n up to full weight
+        assert w[0] == pytest.approx(1 / 5)
+        assert w[0] < w[1] <= 1.0
+
+    def test_initial_points_random(self):
+        space, tpe = make_tpe()
+        pts = tpe.suggest(3)
+        assert len(pts) == 3
+        assert all(p in space for p in pts)
+
+
+class TestSuggest:
+    def test_ei_suggestions_in_space_and_deterministic(self):
+        space, tpe1 = make_tpe(seed=42)
+        _, tpe2 = make_tpe(seed=42)
+        obs = [({"x": float(x), "c": c}, (x / 5.0) ** 2)
+               for x, c in zip(range(-8, 8, 2), "abcabcab")]
+        for params, y in obs:
+            tpe1.observe([completed(space, params, y)])
+            tpe2.observe([completed(space, params, y)])
+        s1, s2 = tpe1.suggest(3), tpe2.suggest(3)
+        assert s1 == s2
+        assert all(p in space for p in s1)
+
+    def test_converges_better_than_random(self):
+        """On f(x) = (x-3)^2 TPE's best-of-40 should land near 3."""
+        space = build_space({"x": "uniform(-10, 10)"})
+        tpe = TPE(space, seed=7, n_initial_points=8)
+        for _ in range(40):
+            p = tpe.suggest(1)[0]
+            tpe.observe([completed(space, p, (p["x"] - 3.0) ** 2)])
+        best_tpe = min(tpe._y)
+        assert best_tpe < 0.15, f"TPE best {best_tpe} too far from optimum"
+        # and the last 10 suggestions concentrate near the optimum
+        xs = [space.sample(1, seed=i)[0]["x"] for i in range(10)]
+        rand_best = min((x - 3.0) ** 2 for x in xs)
+        assert best_tpe <= rand_best + 1e-9
+
+    def test_categorical_frequencies_steer(self):
+        """Category 'b' always good → l should favor suggesting 'b'."""
+        space = build_space({"c": "choices(['a', 'b', 'c'])", "x": "uniform(0, 1)"})
+        tpe = TPE(space, seed=3, n_initial_points=6)
+        rng = np.random.default_rng(0)
+        for i in range(30):
+            c = "abc"[i % 3]
+            y = 0.1 if c == "b" else 1.0 + rng.random()
+            tpe.observe([completed(space, {"c": c, "x": float(rng.random())}, y)])
+        suggestions = tpe.suggest(10)
+        n_b = sum(1 for p in suggestions if p["c"] == "b")
+        assert n_b >= 7
+
+    def test_fidelity_pinned_to_max(self):
+        space = build_space(
+            {"x": "uniform(0, 1)", "epochs": "fidelity(1, 16, base=4)"}
+        )
+        tpe = TPE(space, seed=0, n_initial_points=2)
+        for i in range(4):
+            tpe.observe(
+                [completed(space, {"x": i / 4, "epochs": 16}, float(i))]
+            )
+        pt = tpe.suggest(1)[0]
+        assert pt["epochs"] == 16
+
+    def test_state_roundtrip(self):
+        space, tpe = make_tpe(seed=5)
+        for i in range(8):
+            tpe.observe([completed(space, {"x": float(i), "c": "a"}, float(i))])
+        clone_space, clone = make_tpe(seed=5)
+        clone.load_state_dict(tpe.state_dict())
+        assert clone.suggest(2) == tpe.suggest(2)

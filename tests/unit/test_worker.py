@@ -1,0 +1,122 @@
+"""Worker runtime tests: Producer pump, workon loop, broken-trial handling.
+
+ref coverage model: Producer/worker unit tests with DumbAlgo (SURVEY.md §4).
+"""
+
+import pytest
+
+from metaopt_tpu.executor import InProcessExecutor
+from metaopt_tpu.ledger import Experiment, MemoryLedger
+from metaopt_tpu.space import build_space
+from metaopt_tpu.worker import Producer, workon
+
+from tests.dumbalgo import DumbAlgo
+
+
+@pytest.fixture
+def space():
+    return build_space({"x": "uniform(-5, 5)"})
+
+
+@pytest.fixture
+def exp(space):
+    return Experiment(
+        "w", MemoryLedger(), space=space, max_trials=5,
+        algorithm={"dumbalgo": {}}, pool_size=2,
+    ).configure()
+
+
+class TestProducer:
+    def test_produce_registers_and_dedups(self, exp, space):
+        algo = DumbAlgo(space, value={"x": 1.0})
+        prod = Producer(exp, algo)
+        assert prod.produce() == 1          # both suggestions identical → 1 kept
+        assert prod.produce() == 0          # same point again → duplicate
+        assert exp.count() == 1
+
+    def test_produce_respects_max_trials_budget(self, exp, space):
+        algo = DumbAlgo(space)
+        prod = Producer(exp, algo)
+        total = 0
+        for _ in range(10):
+            total += prod.produce(pool_size=3)
+        assert exp.count() == 5             # never floods past max_trials
+        assert total == 5
+
+    def test_produce_marks_algo_done(self, exp, space):
+        algo = DumbAlgo(space, done_after=0)
+        Producer(exp, algo).produce()
+        assert exp.is_done
+
+    def test_observe_feeds_completed(self, exp, space):
+        algo = DumbAlgo(space)
+        prod = Producer(exp, algo)
+        prod.produce()
+        t = exp.reserve_trial("w")
+        exp.push_results(t, [{"name": "o", "type": "objective", "value": 1.0}])
+        prod.produce()
+        assert algo.n_observed == 1
+
+
+class TestWorkon:
+    def test_runs_to_max_trials(self, exp):
+        stats = workon(exp, InProcessExecutor(lambda p: p["x"] ** 2), "w0")
+        assert stats.completed == 5
+        assert exp.is_done
+        assert exp.stats["best"]["objective"] >= 0
+
+    def test_broken_trials_dont_kill_worker(self, space):
+        exp = Experiment(
+            "b", MemoryLedger(), space=space, max_trials=4,
+            algorithm={"dumbalgo": {}},
+        ).configure()
+
+        calls = {"n": 0}
+
+        def flaky(params):
+            calls["n"] += 1
+            if calls["n"] % 2 == 0:
+                raise RuntimeError("boom")
+            return params["x"] ** 2
+
+        stats = workon(exp, InProcessExecutor(flaky), "w0", max_idle_cycles=20)
+        assert stats.broken >= 1
+        assert stats.completed == 4          # max_trials counts completions only
+        assert exp.count("completed") == 4
+
+    def test_worker_trials_cap(self, exp):
+        stats = workon(
+            exp, InProcessExecutor(lambda p: 0.0), "w0", worker_trials=2
+        )
+        assert stats.reserved == 2
+        assert not exp.is_done
+
+    def test_two_sequential_workers_share_experiment(self, space):
+        ledger = MemoryLedger()
+        e1 = Experiment("s", ledger, space=space, max_trials=6,
+                        algorithm={"dumbalgo": {}}).configure()
+        workon(e1, InProcessExecutor(lambda p: p["x"]), "w1", worker_trials=3)
+        e2 = Experiment("s", ledger).configure()   # joins by name, adopts config
+        stats = workon(e2, InProcessExecutor(lambda p: p["x"]), "w2")
+        assert ledger.count("s", "completed") == 6
+        assert stats.completed == 3
+
+    def test_gradient_descent_protocol_end_to_end(self):
+        """The typed-results protocol: gradient results drive the algorithm."""
+        space = build_space({"x": "uniform(-5, 5)"})
+        exp = Experiment(
+            "g", MemoryLedger(), space=space, max_trials=12,
+            algorithm={"gradient_descent": {"learning_rate": 0.2, "seed": 4}},
+        ).configure()
+
+        def objective(p):
+            x = p["x"]
+            return [
+                {"name": "f", "type": "objective", "value": (x - 1.0) ** 2},
+                {"name": "df", "type": "gradient", "value": [2 * (x - 1.0)]},
+            ]
+
+        workon(exp, InProcessExecutor(objective), "w0")
+        best = exp.stats["best"]
+        assert best["objective"] < 0.05
+        assert abs(best["params"]["x"] - 1.0) < 0.25
