@@ -70,6 +70,24 @@ def build_parser() -> argparse.ArgumentParser:
     common(st)
     st.add_argument("--json", action="store_true", dest="as_json")
 
+    srv = sub.add_parser(
+        "serve", help="run the pod coordinator (single-writer ledger service)"
+    )
+    srv.add_argument("--config", help="framework config YAML")
+    srv.add_argument("--host", default=None,
+                     help="bind address (default: config coordinator.host)")
+    srv.add_argument("--port", type=int, default=None,
+                     help="0 binds an ephemeral port (printed at startup)")
+    srv.add_argument("--ledger", default=None,
+                     help="inner backing store: 'memory' or a directory path")
+    srv.add_argument("--snapshot", dest="snapshot_path", default=None,
+                     help="snapshot file for crash/resume")
+    srv.add_argument("--snapshot-interval-s", type=float, default=30.0)
+    srv.add_argument("--stale-timeout-s", type=float, default=120.0,
+                     help="pacemaker: re-free reservations idle this long")
+    srv.add_argument("--event-log", dest="event_log_path", default=None,
+                     help="JSONL event log path")
+
     return p
 
 
@@ -221,11 +239,43 @@ def _cmd_status(args, cfg: Dict[str, Any]) -> int:
     return 0
 
 
+def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
+    from metaopt_tpu.coord.server import CoordServer, serve_forever
+
+    # CLI flags > config file (`ledger:`/`coordinator:` sections) > defaults
+    inner = None
+    inner_spec = args.ledger
+    if inner_spec is None:
+        lcfg = cfg.get("ledger") or {}
+        if lcfg.get("type", "memory") == "file":
+            inner_spec = lcfg.get("path") or os.path.expanduser(
+                "~/.metaopt_tpu/ledger"
+            )
+    if inner_spec and inner_spec != "memory":
+        from metaopt_tpu.ledger.backends import make_ledger as _ml
+
+        inner = _ml({"type": "file", "path": inner_spec})
+    coord_cfg = cfg.get("coordinator") or {}
+    server = CoordServer(
+        inner=inner,
+        host=args.host if args.host is not None
+        else coord_cfg.get("host", "127.0.0.1"),
+        port=args.port if args.port is not None else coord_cfg.get("port", 0),
+        snapshot_path=args.snapshot_path,
+        snapshot_interval_s=args.snapshot_interval_s,
+        stale_timeout_s=args.stale_timeout_s,
+        event_log_path=args.event_log_path,
+    )
+    serve_forever(server)
+    return 0
+
+
 _COMMANDS = {
     "hunt": _cmd_hunt,
     "init-only": _cmd_init_only,
     "insert": _cmd_insert,
     "status": _cmd_status,
+    "serve": _cmd_serve,
 }
 
 

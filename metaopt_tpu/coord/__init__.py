@@ -1,0 +1,29 @@
+"""Pod coordinator: the single-writer trial-ledger service.
+
+The reference's coordination substrate is MongoDB — stateless workers racing
+on atomic document ops (SURVEY.md §2.7). On a TPU pod there is no Mongo; the
+idiomatic substrate is a single-writer coordinator process (conventionally
+the host driving chip 0) that owns the ledger and serves reserve/report/
+heartbeat to every worker over a tiny TCP channel (SURVEY.md §7 L4). The
+DB's atomicity guarantees become trivial: one writer, one lock.
+
+Pieces:
+
+- :mod:`~metaopt_tpu.coord.protocol` — length-prefixed JSON framing.
+- :mod:`~metaopt_tpu.coord.server` — :class:`CoordServer`: wraps any inner
+  :class:`~metaopt_tpu.ledger.backends.LedgerBackend`, adds the pacemaker
+  sweep (stale-reservation release), periodic ledger snapshots for
+  crash/resume, a JSONL event log, and a control-plane ``signal`` channel
+  (pod-global early-stop: a ``stop`` signal fails the trial's next
+  heartbeat, which tears it down wherever it runs).
+- :mod:`~metaopt_tpu.coord.client_backend` — :class:`CoordLedgerClient`, a
+  drop-in ``LedgerBackend`` registered as ``"coord"`` so every layer above
+  (Experiment, Producer, workon) is oblivious to the RPC hop.
+- :mod:`~metaopt_tpu.coord.pod` — ``jax.distributed`` glue: process 0 hosts
+  the service, the address is agreed pod-wide.
+"""
+
+from metaopt_tpu.coord.client_backend import CoordLedgerClient
+from metaopt_tpu.coord.server import CoordServer
+
+__all__ = ["CoordServer", "CoordLedgerClient"]

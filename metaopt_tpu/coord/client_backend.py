@@ -1,0 +1,172 @@
+"""CoordLedgerClient — the ``"coord"`` ledger backend.
+
+A drop-in :class:`~metaopt_tpu.ledger.backends.LedgerBackend` whose every
+method is one RPC to a :class:`~metaopt_tpu.coord.server.CoordServer`. The
+layers above (Experiment / Producer / workon) cannot tell the difference —
+exactly as the reference's workers cannot tell a local mongod from a remote
+one (SURVEY.md §3.2: multi-node ≡ same URL).
+
+Connections are per-(process, thread) and lazily rebuilt, so the client
+survives ``fork``/``spawn`` into worker processes and transient coordinator
+restarts (one reconnect attempt per call — safe because every ledger op is
+idempotent or CAS-guarded).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Any, Dict, List, Optional
+
+from metaopt_tpu.coord.protocol import recv_msg, send_msg
+from metaopt_tpu.ledger.backends import (
+    DuplicateExperimentError,
+    DuplicateTrialError,
+    LedgerBackend,
+    ledger_registry,
+)
+from metaopt_tpu.ledger.trial import Trial
+
+_ERRORS = {
+    "DuplicateTrialError": DuplicateTrialError,
+    "DuplicateExperimentError": DuplicateExperimentError,
+    "KeyError": KeyError,
+    "ValueError": ValueError,
+}
+
+
+class CoordRPCError(RuntimeError):
+    """Server-side failure that doesn't map to a known ledger exception."""
+
+
+@ledger_registry.register("coord")
+class CoordLedgerClient(LedgerBackend):
+    def __init__(
+        self,
+        host: Optional[str] = None,
+        port: Optional[int] = None,
+        connect_timeout_s: float = 10.0,
+        **_: Any,
+    ) -> None:
+        self.host = host or os.environ.get("METAOPT_TPU_COORD_HOST", "127.0.0.1")
+        self.port = int(port or os.environ.get("METAOPT_TPU_COORD_PORT", 0))
+        if not self.port:
+            raise ValueError("coord backend needs a port (coord://host:port)")
+        self.connect_timeout_s = connect_timeout_s
+        self._local = threading.local()
+
+    # -- connection management --------------------------------------------
+    def _sock(self) -> socket.socket:
+        # (pid, sock) so a socket inherited across fork is never reused
+        pid_sock = getattr(self._local, "pid_sock", None)
+        if pid_sock is not None and pid_sock[0] == os.getpid():
+            return pid_sock[1]
+        s = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout_s
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(None)
+        self._local.pid_sock = (os.getpid(), s)
+        return s
+
+    def _drop_sock(self) -> None:
+        pid_sock = getattr(self._local, "pid_sock", None)
+        if pid_sock is not None:
+            try:
+                pid_sock[1].close()
+            except OSError:
+                pass
+        self._local.pid_sock = None
+
+    def _call(self, op: str, **args: Any) -> Any:
+        msg = {"op": op, "args": args}
+        for attempt in (0, 1):
+            try:
+                s = self._sock()
+                send_msg(s, msg)
+                reply = recv_msg(s)
+                if reply is None:
+                    raise ConnectionError("coordinator closed the connection")
+                break
+            except (ConnectionError, BrokenPipeError, OSError):
+                self._drop_sock()
+                if attempt:
+                    raise
+        if reply["ok"]:
+            return reply["result"]
+        exc = _ERRORS.get(reply["error"], CoordRPCError)
+        raise exc(reply["msg"])
+
+    def ping(self) -> Dict[str, Any]:
+        return self._call("ping")
+
+    # -- experiment docs ---------------------------------------------------
+    def create_experiment(self, config: Dict[str, Any]) -> None:
+        self._call("create_experiment", config=config)
+
+    def load_experiment(self, name: str) -> Optional[Dict[str, Any]]:
+        return self._call("load_experiment", name=name)
+
+    def update_experiment(self, name: str, patch: Dict[str, Any]) -> None:
+        self._call("update_experiment", name=name, patch=patch)
+
+    def list_experiments(self) -> List[str]:
+        return self._call("list_experiments")
+
+    # -- trials ------------------------------------------------------------
+    def register(self, trial: Trial) -> None:
+        self._call("register", trial=trial.to_dict())
+
+    def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
+        doc = self._call("reserve", experiment=experiment, worker=worker)
+        return Trial.from_dict(doc) if doc else None
+
+    def update_trial(
+        self,
+        trial: Trial,
+        expected_status: Optional[str] = None,
+        expected_worker: Optional[str] = None,
+    ) -> bool:
+        return self._call(
+            "update_trial",
+            trial=trial.to_dict(),
+            expected_status=expected_status,
+            expected_worker=expected_worker,
+        )
+
+    def heartbeat(self, experiment: str, trial_id: str, worker: str) -> bool:
+        r = self._call(
+            "heartbeat", experiment=experiment, trial_id=trial_id, worker=worker
+        )
+        # a "stop" signal fails the heartbeat on purpose: the executor treats
+        # it as a lost reservation and tears the trial down — this is how a
+        # coordinator-side judge prunes a trial running anywhere on the pod
+        return bool(r["ours"]) and r.get("signal") != "stop"
+
+    def get(self, experiment: str, trial_id: str) -> Optional[Trial]:
+        doc = self._call("get", experiment=experiment, trial_id=trial_id)
+        return Trial.from_dict(doc) if doc else None
+
+    def fetch(self, experiment: str, status=None) -> List[Trial]:
+        if isinstance(status, tuple):
+            status = list(status)
+        docs = self._call("fetch", experiment=experiment, status=status)
+        return [Trial.from_dict(d) for d in docs]
+
+    def release_stale(self, experiment: str, timeout_s: float) -> List[Trial]:
+        # server-side so the sweep is atomic with every other mutation
+        docs = self._call(
+            "release_stale", experiment=experiment, timeout_s=timeout_s
+        )
+        return [Trial.from_dict(d) for d in docs]
+
+    # -- control plane -----------------------------------------------------
+    def set_signal(self, experiment: str, trial_id: str, signal: str) -> None:
+        """Pod-global control message, e.g. ``"stop"`` to prune a trial."""
+        self._call(
+            "set_signal", experiment=experiment, trial_id=trial_id, signal=signal
+        )
+
+    def snapshot(self, path: Optional[str] = None) -> str:
+        return self._call("snapshot", path=path)

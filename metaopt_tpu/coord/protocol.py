@@ -1,0 +1,54 @@
+"""Wire framing for the coordinator channel.
+
+One message = 4-byte big-endian length + UTF-8 JSON. Requests are
+``{"op": str, "args": dict}``; responses ``{"ok": true, "result": ...}`` or
+``{"ok": false, "error": <exception class name>, "msg": str}``. JSON over a
+socket (not pickle) keeps the channel language-neutral and injection-safe;
+trial documents already round-trip through dicts for the file ledger, so the
+same ``to_dict``/``from_dict`` pair is the marshalling layer here.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, Optional
+
+_HDR = struct.Struct(">I")
+MAX_MSG_BYTES = 64 * 1024 * 1024  # a fetch of ~100k trial docs fits well under
+
+
+class ProtocolError(RuntimeError):
+    pass
+
+
+def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
+    payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_MSG_BYTES:
+        raise ProtocolError(f"message too large: {len(payload)} bytes")
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None  # peer closed
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    """Read one framed message; None on clean EOF before a header."""
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    (length,) = _HDR.unpack(hdr)
+    if length > MAX_MSG_BYTES:
+        raise ProtocolError(f"frame too large: {length} bytes")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        raise ProtocolError("peer closed mid-frame")
+    return json.loads(payload.decode("utf-8"))

@@ -1,0 +1,330 @@
+"""CoordServer — the single-writer ledger service.
+
+Replaces the reference's MongoDB bus (SURVEY.md §2.7): instead of N workers
+racing on atomic document ops, one process owns a
+:class:`~metaopt_tpu.ledger.backends.LedgerBackend` and serializes every
+mutation under one lock. Workers connect with
+:class:`~metaopt_tpu.coord.client_backend.CoordLedgerClient`.
+
+Beyond plain CRUD forwarding the server owns three pod-level duties the
+reference either lacked (v0-era warts, SURVEY.md §5) or delegated to Mongo:
+
+- **Pacemaker sweep**: a background thread re-frees ``reserved`` trials whose
+  heartbeat lapsed (dead worker / preempted host) — failure detection.
+- **Snapshots**: periodic backend-agnostic dumps of every experiment +
+  trial doc (+ control signals) to one JSON file; ``restore()`` reloads it,
+  and algorithm state is rebuilt upstream by observe-replay over completed
+  trials — checkpoint/resume without a database.
+- **Control signals**: ``set_signal(exp, trial_id, "stop")`` makes that
+  trial's next ``heartbeat`` answer False, which every executor treats as a
+  lost reservation and tears the trial down. This is the pod-global
+  early-stop broadcast path (coordinator channel in lieu of ICI collectives
+  for control-plane traffic, SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from metaopt_tpu.coord.protocol import ProtocolError, recv_msg, send_msg
+from metaopt_tpu.ledger.backends import LedgerBackend, MemoryLedger
+from metaopt_tpu.ledger.trial import Trial
+
+log = logging.getLogger(__name__)
+
+
+class CoordServer:
+    """Serve a ledger backend over TCP; one thread per client connection.
+
+    All ledger ops run under ``self._lock`` — the single-writer guarantee.
+    ``port=0`` binds an ephemeral port (tests); ``.address`` reports it.
+    """
+
+    def __init__(
+        self,
+        inner: Optional[LedgerBackend] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        snapshot_path: Optional[str] = None,
+        snapshot_interval_s: float = 30.0,
+        stale_timeout_s: Optional[float] = None,
+        sweep_interval_s: float = 5.0,
+        event_log_path: Optional[str] = None,
+    ) -> None:
+        self.inner = inner if inner is not None else MemoryLedger()
+        self._bind = (host, port)
+        self.snapshot_path = snapshot_path
+        self.snapshot_interval_s = snapshot_interval_s
+        self.stale_timeout_s = stale_timeout_s
+        self.sweep_interval_s = sweep_interval_s
+        self.event_log_path = event_log_path
+
+        self._lock = threading.RLock()
+        self._signals: Dict[Tuple[str, str], str] = {}  # (exp, trial_id) → signal
+        self._sock: Optional[socket.socket] = None
+        self._threads: list[threading.Thread] = []
+        self._stopping = threading.Event()
+        self._ops = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def address(self) -> Tuple[str, int]:
+        assert self._sock is not None, "server not started"
+        return self._sock.getsockname()[:2]
+
+    def start(self) -> "CoordServer":
+        if self.snapshot_path and os.path.exists(self.snapshot_path):
+            self.restore(self.snapshot_path)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(self._bind)
+        self._sock.listen(128)
+        self._spawn(self._accept_loop, "coord-accept")
+        if self.stale_timeout_s is not None or self.snapshot_path:
+            self._spawn(self._housekeeping_loop, "coord-sweep")
+        log.info("coordinator listening on %s:%d", *self.address)
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self.snapshot_path:
+            self.snapshot(self.snapshot_path)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def __enter__(self) -> "CoordServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=name, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- background duties -------------------------------------------------
+    def _housekeeping_loop(self) -> None:
+        last_snap = time.time()
+        while not self._stopping.wait(min(self.sweep_interval_s, 1.0)):
+            if self.stale_timeout_s is not None:
+                with self._lock:
+                    for name in self.inner.list_experiments():
+                        released = self.inner.release_stale(
+                            name, self.stale_timeout_s
+                        )
+                        for t in released:
+                            self._event("release_stale", name, trial=t.id)
+            if (
+                self.snapshot_path
+                and time.time() - last_snap >= self.snapshot_interval_s
+            ):
+                self.snapshot(self.snapshot_path)
+                last_snap = time.time()
+
+    # -- snapshot / restore ------------------------------------------------
+    def snapshot(self, path: str) -> None:
+        """Backend-agnostic full dump; atomic replace so a crash mid-write
+        never corrupts the previous snapshot."""
+        with self._lock:
+            state = {
+                "version": 1,
+                "ts": time.time(),
+                "experiments": {
+                    name: self.inner.load_experiment(name)
+                    for name in self.inner.list_experiments()
+                },
+                "trials": {
+                    name: [t.to_dict() for t in self.inner.fetch(name)]
+                    for name in self.inner.list_experiments()
+                },
+                "signals": [
+                    {"experiment": e, "trial": t, "signal": s}
+                    for (e, t), s in self._signals.items()
+                ],
+            }
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, path)
+
+    def restore(self, path: str) -> None:
+        with open(path) as f:
+            state = json.load(f)
+        with self._lock:
+            existing = set(self.inner.list_experiments())
+            for name, config in state["experiments"].items():
+                if name not in existing and config is not None:
+                    self.inner.create_experiment(config)
+            for name, docs in state["trials"].items():
+                have = {t.id for t in self.inner.fetch(name)}
+                for doc in docs:
+                    if doc["id"] not in have:
+                        self.inner.register(Trial.from_dict(doc))
+            for sig in state.get("signals", []):
+                self._signals[(sig["experiment"], sig["trial"])] = sig["signal"]
+        log.info("restored %d experiments from %s", len(state["experiments"]), path)
+
+    # -- event log ---------------------------------------------------------
+    def _event(self, op: str, experiment: Optional[str], **extra: Any) -> None:
+        if not self.event_log_path:
+            return
+        rec = {"ts": round(time.time(), 4), "op": op, "experiment": experiment}
+        rec.update(extra)
+        try:
+            with open(self.event_log_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:  # observability must never take down the service
+            log.exception("event log write failed")
+
+    # -- request dispatch --------------------------------------------------
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._stopping.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                try:
+                    msg = recv_msg(conn)
+                except (ProtocolError, ConnectionError, json.JSONDecodeError):
+                    return
+                if msg is None:
+                    return
+                try:
+                    result = self._dispatch(msg.get("op"), msg.get("args") or {})
+                    reply = {"ok": True, "result": result}
+                except Exception as e:  # marshal, don't crash the service
+                    reply = {
+                        "ok": False,
+                        "error": type(e).__name__,
+                        "msg": str(e),
+                    }
+                try:
+                    send_msg(conn, reply)
+                except (ConnectionError, BrokenPipeError):
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, op: Optional[str], a: Dict[str, Any]) -> Any:
+        with self._lock:
+            self._ops += 1
+            if op == "ping":
+                return {"pong": True, "ops": self._ops}
+            if op == "create_experiment":
+                self.inner.create_experiment(a["config"])
+                self._event("create_experiment", a["config"].get("name"))
+                return None
+            if op == "load_experiment":
+                return self.inner.load_experiment(a["name"])
+            if op == "update_experiment":
+                self.inner.update_experiment(a["name"], a["patch"])
+                return None
+            if op == "list_experiments":
+                return self.inner.list_experiments()
+            if op == "register":
+                trial = Trial.from_dict(a["trial"])
+                self.inner.register(trial)
+                self._event("register", trial.experiment, trial=trial.id)
+                return None
+            if op == "reserve":
+                t = self.inner.reserve(a["experiment"], a["worker"])
+                if t is not None:
+                    self._event(
+                        "reserve", a["experiment"], trial=t.id, worker=a["worker"]
+                    )
+                return t.to_dict() if t else None
+            if op == "update_trial":
+                trial = Trial.from_dict(a["trial"])
+                ok = self.inner.update_trial(
+                    trial,
+                    expected_status=a.get("expected_status"),
+                    expected_worker=a.get("expected_worker"),
+                )
+                if ok:
+                    self._event(
+                        "update_trial", trial.experiment,
+                        trial=trial.id, status=trial.status,
+                    )
+                    if trial.status in ("completed", "broken", "interrupted"):
+                        self._signals.pop((trial.experiment, trial.id), None)
+                return ok
+            if op == "heartbeat":
+                ours = self.inner.heartbeat(
+                    a["experiment"], a["trial_id"], a["worker"]
+                )
+                signal = self._signals.get((a["experiment"], a["trial_id"]))
+                return {"ours": ours, "signal": signal}
+            if op == "get":
+                t = self.inner.get(a["experiment"], a["trial_id"])
+                return t.to_dict() if t else None
+            if op == "fetch":
+                status = a.get("status")
+                if isinstance(status, list):
+                    status = tuple(status)
+                return [t.to_dict() for t in self.inner.fetch(a["experiment"], status)]
+            if op == "release_stale":
+                released = self.inner.release_stale(a["experiment"], a["timeout_s"])
+                return [t.to_dict() for t in released]
+            if op == "set_signal":
+                self._signals[(a["experiment"], a["trial_id"])] = a["signal"]
+                self._event(
+                    "set_signal", a["experiment"],
+                    trial=a["trial_id"], signal=a["signal"],
+                )
+                return None
+            if op == "snapshot":
+                path = a.get("path") or self.snapshot_path
+                if not path:
+                    raise ValueError("no snapshot path configured")
+                self.snapshot(path)
+                return path
+            raise ValueError(f"unknown op: {op!r}")
+
+
+def serve_forever(server: CoordServer) -> None:
+    """Run until SIGINT/SIGTERM; used by the ``mtpu serve`` CLI command.
+
+    SIGTERM is how pod schedulers preempt — it must snapshot before dying,
+    same as Ctrl-C, or everything since the last periodic snapshot is lost.
+    """
+    import signal as _signal
+
+    stop = threading.Event()
+    prev = _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+    server.start()
+    host, port = server.address
+    print(f"coordinator ready at coord://{host}:{port}", flush=True)
+    try:
+        while not stop.wait(1.0):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        _signal.signal(_signal.SIGTERM, prev)

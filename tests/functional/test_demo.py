@@ -126,6 +126,42 @@ class TestOtherCommands:
                      "--ledger", str(tmp_path / "l"), BLACK_BOX, "-x", "3"])
 
 
+class TestServeCommand:
+    def test_hunt_against_live_coordinator_service(self, tmp_path, capsys):
+        """`mtpu serve` + `mtpu hunt --ledger coord://…`: the pod deployment
+        shape, end-to-end through two real processes."""
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "metaopt_tpu", "serve", "--port", "0",
+             "--snapshot", str(tmp_path / "snap.json")],
+            stdout=subprocess.PIPE, text=True, cwd=REPO,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("coordinator ready at coord://"), line
+            addr = line.strip().rsplit("coord://", 1)[1]
+
+            rc = run_cli([
+                "hunt", "-n", "demo", "--ledger", f"coord://{addr}",
+                "--max-trials", "8", "--pool-size", "2",
+                "--config", TestHuntDemo._algo_config(
+                    tmp_path, {"random": {"seed": 3}}
+                ),
+                BLACK_BOX, "-x~uniform(-50, 50)",
+            ])
+            assert rc == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["total"]["completed"] == 8
+
+            rc = run_cli(["status", "-n", "demo",
+                          "--ledger", f"coord://{addr}", "--json"])
+            assert rc == 0
+            stats = json.loads(capsys.readouterr().out)
+            assert stats[0]["by_status"]["completed"] == 8
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
 class TestJudgePruning:
     def test_judge_prunes_streaming_trial(self, tmp_path):
         """DumbAlgo's judge stops any trial whose partial objective < 1e9 —

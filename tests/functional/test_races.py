@@ -17,10 +17,8 @@ from metaopt_tpu.space import build_space
 from metaopt_tpu.worker import workon
 
 
-def _worker(ledger_dir: str, worker_id: str, out_path: str) -> None:
-    exp = Experiment(
-        "race", make_ledger({"type": "file", "path": ledger_dir})
-    ).configure()
+def _worker(ledger_cfg: dict, worker_id: str, out_path: str) -> None:
+    exp = Experiment("race", make_ledger(ledger_cfg)).configure()
     stats = workon(
         exp,
         InProcessExecutor(lambda p: (p["x"] - 1.0) ** 2),
@@ -43,7 +41,10 @@ def test_four_workers_no_double_execution(tmp_path):
     ctx = mp.get_context("spawn")
     outs = [str(tmp_path / f"w{i}.json") for i in range(4)]
     procs = [
-        ctx.Process(target=_worker, args=(ledger_dir, f"w{i}", outs[i]))
+        ctx.Process(
+            target=_worker,
+            args=({"type": "file", "path": ledger_dir}, f"w{i}", outs[i]),
+        )
         for i in range(4)
     ]
     for p in procs:
@@ -63,3 +64,42 @@ def test_four_workers_no_double_execution(tmp_path):
     ).configure()
     assert exp.count("completed") == 24
     assert exp.is_done
+
+
+def test_four_workers_against_one_coordinator(tmp_path):
+    """The pod story (SURVEY.md §2.7): N worker processes, one single-writer
+    coordinator, no trial executed twice, totals add up."""
+    from metaopt_tpu.coord import CoordServer
+
+    with CoordServer() as server:
+        host, port = server.address
+        ledger = make_ledger({"type": "coord", "host": host, "port": port})
+        Experiment(
+            "race", ledger,
+            space=build_space({"x": "uniform(-5, 5)"}),
+            max_trials=24, pool_size=4,
+            algorithm={"random": {"seed": 9}},
+        ).configure()
+
+        ctx = mp.get_context("spawn")
+        outs = [str(tmp_path / f"cw{i}.json") for i in range(4)]
+        ledger_cfg = {"type": "coord", "host": host, "port": port}
+        procs = [
+            ctx.Process(target=_worker, args=(ledger_cfg, f"w{i}", outs[i]))
+            for i in range(4)
+        ]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=120)
+            assert p.exitcode == 0
+
+        per_worker = [json.load(open(o)) for o in outs]
+        total = sum(w["completed"] for w in per_worker)
+        executed = [e["trial"] for w in per_worker for e in w["events"]]
+        assert len(executed) == len(set(executed)), "a trial ran on two workers"
+        assert total == 24
+
+        exp = Experiment("race", ledger).configure()
+        assert exp.count("completed") == 24
+        assert exp.is_done

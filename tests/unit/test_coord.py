@@ -1,0 +1,239 @@
+"""Coordinator service tests: snapshots/resume, pacemaker, control signals.
+
+ref coverage model (SURVEY.md §4/§5): the DB-as-checkpoint doctrine becomes
+snapshot + observe-replay; the pacemaker becomes a server-side sweep; the
+judge/early-stop hook becomes the signal channel. The full ledger CRUD
+contract is already exercised RPC-side by tests/unit/test_ledger.py's
+"coord" parametrization.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+from metaopt_tpu.ledger import Experiment, Trial
+from metaopt_tpu.ledger.backends import MemoryLedger
+
+
+def _client(server):
+    host, port = server.address
+    return CoordLedgerClient(host=host, port=port)
+
+
+@pytest.fixture()
+def server():
+    with CoordServer() as s:
+        yield s
+
+
+def _trial(x, exp="exp"):
+    return Trial(params={"x": x}, experiment=exp)
+
+
+class TestSnapshotResume:
+    def test_roundtrip_preserves_experiments_trials_signals(self, tmp_path):
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap) as s1:
+            c = _client(s1)
+            c.create_experiment({"name": "exp", "max_trials": 7})
+            t1, t2 = _trial(1.0), _trial(2.0)
+            c.register(t1)
+            c.register(t2)
+            got = c.reserve("exp", "w0")
+            got.transition("completed")
+            got.attach_results(
+                [{"name": "objective", "type": "objective", "value": 0.5}]
+            )
+            assert c.update_trial(got, expected_status="reserved")
+            c.set_signal("exp", t2.id, "stop")
+        # stop() snapshots; a fresh server restores from the same path
+        with CoordServer(snapshot_path=snap) as s2:
+            c2 = _client(s2)
+            doc = c2.load_experiment("exp")
+            assert doc["max_trials"] == 7
+            trials = c2.fetch("exp")
+            assert {t.id for t in trials} == {t1.id, t2.id}
+            done = [t for t in trials if t.status == "completed"]
+            assert len(done) == 1 and done[0].objective == 0.5
+            # the signal survived: heartbeat for t2 must report stop
+            c2.register_ok = c2.reserve("exp", "w1")  # reserve t2
+            assert c2.heartbeat("exp", t2.id, "w1") is False
+
+    def test_restore_is_idempotent_with_persistent_inner(self, tmp_path):
+        # snapshot + file inner: restore must not duplicate existing docs
+        from metaopt_tpu.ledger.backends import FileLedger
+
+        snap = str(tmp_path / "snap.json")
+        inner_dir = str(tmp_path / "inner")
+        with CoordServer(
+            inner=FileLedger(path=inner_dir), snapshot_path=snap
+        ) as s1:
+            c = _client(s1)
+            c.create_experiment({"name": "exp"})
+            c.register(_trial(1.0))
+        with CoordServer(
+            inner=FileLedger(path=inner_dir), snapshot_path=snap
+        ) as s2:
+            c2 = _client(s2)
+            assert len(c2.fetch("exp")) == 1
+
+    def test_on_demand_snapshot_op(self, server, tmp_path):
+        c = _client(server)
+        c.create_experiment({"name": "exp"})
+        path = str(tmp_path / "manual.json")
+        assert c.snapshot(path) == path
+        state = json.load(open(path))
+        assert "exp" in state["experiments"]
+
+
+class TestPacemaker:
+    def test_sweeper_releases_dead_workers_reservation(self):
+        with CoordServer(stale_timeout_s=0.2, sweep_interval_s=0.05) as s:
+            c = _client(s)
+            c.create_experiment({"name": "exp"})
+            c.register(_trial(1.0))
+            t = c.reserve("exp", "dead-worker")
+            assert c.heartbeat("exp", t.id, "dead-worker")
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                fresh = c.get("exp", t.id)
+                if fresh.status == "new":
+                    break
+                time.sleep(0.05)
+            assert fresh.status == "new" and fresh.worker is None
+            # and it is reservable again by a live worker
+            again = c.reserve("exp", "live-worker")
+            assert again is not None and again.id == t.id
+
+    def test_live_heartbeat_prevents_release(self):
+        with CoordServer(stale_timeout_s=0.3, sweep_interval_s=0.05) as s:
+            c = _client(s)
+            c.create_experiment({"name": "exp"})
+            c.register(_trial(1.0))
+            t = c.reserve("exp", "w0")
+            for _ in range(8):
+                assert c.heartbeat("exp", t.id, "w0")
+                time.sleep(0.1)
+            assert c.get("exp", t.id).status == "reserved"
+
+
+class TestControlSignals:
+    def test_stop_signal_fails_heartbeat(self, server):
+        c = _client(server)
+        c.create_experiment({"name": "exp"})
+        c.register(_trial(1.0))
+        t = c.reserve("exp", "w0")
+        assert c.heartbeat("exp", t.id, "w0") is True
+        c.set_signal("exp", t.id, "stop")
+        assert c.heartbeat("exp", t.id, "w0") is False
+
+    def test_signal_cleared_when_trial_finishes(self, server):
+        c = _client(server)
+        c.create_experiment({"name": "exp"})
+        tr = _trial(1.0)
+        c.register(tr)
+        t = c.reserve("exp", "w0")
+        c.set_signal("exp", t.id, "stop")
+        t.transition("interrupted")
+        assert c.update_trial(t, expected_status="reserved")
+        # trial re-queued manually: signal must not haunt the retry
+        t.status = "new"
+        t.worker = None
+        assert c.update_trial(t)
+        t2 = c.reserve("exp", "w1")
+        assert c.heartbeat("exp", t2.id, "w1") is True
+
+
+class TestEventLog:
+    def test_mutations_logged_as_jsonl(self, tmp_path):
+        log_path = str(tmp_path / "events.jsonl")
+        with CoordServer(event_log_path=log_path) as s:
+            c = _client(s)
+            c.create_experiment({"name": "exp"})
+            c.register(_trial(1.0))
+            t = c.reserve("exp", "w0")
+            t.transition("completed")
+            c.update_trial(t, expected_status="reserved")
+        events = [json.loads(line) for line in open(log_path)]
+        ops = [e["op"] for e in events]
+        assert ops == ["create_experiment", "register", "reserve", "update_trial"]
+        assert all(e["experiment"] == "exp" for e in events)
+
+
+class TestConcurrency:
+    def test_many_threads_never_double_reserve(self, server):
+        c0 = _client(server)
+        c0.create_experiment({"name": "exp"})
+        for i in range(40):
+            c0.register(_trial(float(i)))
+
+        wins = []
+        lock = threading.Lock()
+
+        def grab(worker):
+            c = _client(server)  # own connection per thread
+            while True:
+                t = c.reserve("exp", worker)
+                if t is None:
+                    return
+                with lock:
+                    wins.append(t.id)
+
+        threads = [
+            threading.Thread(target=grab, args=(f"w{i}",)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(wins) == 40 and len(set(wins)) == 40
+
+    def test_client_reconnects_after_connection_drop(self, server):
+        c = _client(server)
+        c.create_experiment({"name": "exp"})
+        c._sock().close()  # simulate a dropped connection
+        assert c.load_experiment("exp") is not None
+
+
+class TestPodGlue:
+    def test_single_process_pod_coordinator(self, tmp_path):
+        from metaopt_tpu.coord.pod import start_pod_coordinator
+
+        host, port, server = start_pod_coordinator(
+            snapshot_path=str(tmp_path / "pod.json"), stale_timeout_s=60.0
+        )
+        try:
+            assert server is not None
+            c = CoordLedgerClient(host=host, port=port)
+            assert c.ping()["pong"] is True
+        finally:
+            server.stop()
+
+    def test_addr_codec_roundtrip(self):
+        from metaopt_tpu.coord.pod import _decode_addr, _encode_addr
+
+        for host, port in [("127.0.0.1", 51234), ("pod-host-3.local", 80)]:
+            assert _decode_addr(_encode_addr(host, port)) == (host, port)
+
+
+class TestExperimentOverCoord:
+    def test_experiment_workflow_end_to_end(self, server):
+        from metaopt_tpu.executor import InProcessExecutor
+        from metaopt_tpu.space import build_space
+        from metaopt_tpu.worker import workon
+
+        c = _client(server)
+        exp = Experiment(
+            "quad",
+            c,
+            space=build_space({"x": "uniform(-5, 5)"}),
+            max_trials=12,
+            pool_size=3,
+            algorithm={"random": {"seed": 1}},
+        ).configure()
+        stats = workon(exp, InProcessExecutor(lambda p: (p["x"] - 1) ** 2))
+        assert stats.completed == 12
+        assert exp.stats["best"]["objective"] >= 0.0

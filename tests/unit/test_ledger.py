@@ -18,11 +18,18 @@ from metaopt_tpu.ledger import (
 from metaopt_tpu.ledger.backends import DuplicateExperimentError
 
 
-@pytest.fixture(params=["memory", "file"])
+@pytest.fixture(params=["memory", "file", "coord"])
 def ledger(request, tmp_path):
     if request.param == "memory":
         return MemoryLedger()
-    return FileLedger(path=str(tmp_path / "ledger"))
+    if request.param == "file":
+        return FileLedger(path=str(tmp_path / "ledger"))
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+
+    server = CoordServer().start()
+    request.addfinalizer(server.stop)
+    host, port = server.address
+    return CoordLedgerClient(host=host, port=port)
 
 
 def _trial(x, exp="exp", status="new"):
