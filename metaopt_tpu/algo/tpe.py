@@ -25,9 +25,16 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+import jax
+
 from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry
 from metaopt_tpu.ledger.trial import Trial
-from metaopt_tpu.ops.tpe_math import adaptive_bandwidths, ei_scores, pad_pow2
+from metaopt_tpu.ops.tpe_math import (
+    adaptive_bandwidths,
+    ei_scores,
+    pad_pow2,
+    tpe_suggest_fused,
+)
 from metaopt_tpu.space import Space, UnitCube
 
 
@@ -69,6 +76,24 @@ class TPE(BaseAlgorithm):
         #: max categories across dims (table width for the kernel)
         self._kmax = int(max(1, self.cube.n_choices.max()))
 
+        # device-resident observation buffers for the fused suggest kernel
+        # (padded to pow2 ≥ n+1 so the prior pseudo-component always fits)
+        self._cap = 0
+        self._Xbuf: Optional[np.ndarray] = None   # host mirror, (cap, d)
+        self._ybuf: Optional[np.ndarray] = None   # host mirror, (cap,)
+        self._n_synced = 0                        # rows already in host mirror
+        self._Xdev = None
+        self._ydev = None
+        self._n_dev = -1                          # count the device copy holds
+        self._n_choices_dev = None
+        self._cont_mask_dev = None
+        # kernel PRNG seed: deterministic for a given ctor seed, OS-entropy
+        # otherwise — unseeded parallel workers must NOT produce identical
+        # suggestion streams (they would dup-collide on register forever)
+        self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
+        self._base_key = None                     # PRNGKey, created lazily
+        self._suggest_count = 0                   # PRNG stream position
+
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:
         self._X.append(self.cube.transform(trial.params))
@@ -76,14 +101,9 @@ class TPE(BaseAlgorithm):
 
     # -- suggest -----------------------------------------------------------
     def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
-        out: List[Dict[str, Any]] = []
-        for _ in range(num):
-            if len(self._y) < self.n_initial_points:
-                pt = self.space.sample(1, seed=self.rng)[0]
-            else:
-                pt = self._suggest_one_ei()
-            out.append(pt)
-        return out
+        if len(self._y) < self.n_initial_points:
+            return [self.space.sample(1, seed=self.rng)[0] for _ in range(num)]
+        return self._suggest_ei(num)
 
     def _split(self) -> Tuple[np.ndarray, np.ndarray]:
         """Indices of good (below) / bad (above) observations."""
@@ -176,34 +196,64 @@ class TPE(BaseAlgorithm):
             out[:, j] = np.clip(draws, 1e-6, 1 - 1e-6)
         return out
 
-    def _suggest_one_ei(self) -> Dict[str, Any]:
-        below, above = self._split()
-        good = self._fit_set(below)
-        bad = self._fit_set(above)
-        cand = self._sample_from(good, self.n_ei_candidates)
-        k = np.maximum(self.cube.n_choices, 1)
-        cand_cat = np.minimum((cand * k[None, :]).astype(np.int32),
-                              (k - 1)[None, :]).astype(np.int32)
-        cont_mask = (~self.cube.categorical_mask).astype(np.float32)
+    def _sync_device(self) -> None:
+        """Mirror host observations into the padded device buffers.
 
-        scores = np.asarray(
-            ei_scores(
-                jnp.asarray(cand),
-                jnp.asarray(good["mu"]), jnp.asarray(good["sigma"]),
-                jnp.asarray(good["logw"]),
-                jnp.asarray(bad["mu"]), jnp.asarray(bad["sigma"]),
-                jnp.asarray(bad["logw"]),
-                jnp.asarray(cont_mask),
-                jnp.asarray(cand_cat),
-                jnp.asarray(good["cat_logp"]), jnp.asarray(bad["cat_logp"]),
+        Appends only the new rows to the host mirror; uploads once per
+        change. Reallocation (pow2 growth) happens O(log n) times total.
+        """
+        n = len(self._y)
+        d = self.cube.n_dims
+        need = pad_pow2(n + 1)
+        if need != self._cap:
+            self._cap = need
+            self._Xbuf = np.full((need, d), 0.5, np.float32)
+            self._ybuf = np.full(need, np.inf, np.float32)
+            self._n_synced = 0
+        if self._n_synced < n:
+            for i in range(self._n_synced, n):
+                self._Xbuf[i] = self._X[i]
+                self._ybuf[i] = self._y[i]
+            self._n_synced = n
+        if self._n_dev != n:
+            self._Xdev = jnp.asarray(self._Xbuf)
+            self._ydev = jnp.asarray(self._ybuf)
+            self._n_dev = n
+        if self._n_choices_dev is None:
+            self._n_choices_dev = jnp.asarray(
+                self.cube.n_choices.astype(np.int32))
+            self._cont_mask_dev = jnp.asarray(~self.cube.categorical_mask)
+
+    def _suggest_one_ei(self) -> Dict[str, Any]:
+        return self._suggest_ei(1)[0]
+
+    def _suggest_ei(self, num: int) -> List[Dict[str, Any]]:
+        """One kernel launch + one readback for the whole pool of ``num``."""
+        self._sync_device()
+        if self._base_key is None:
+            self._base_key = jax.random.PRNGKey(self._kernel_seed)
+        count = self._suggest_count
+        self._suggest_count += 1
+        best = np.asarray(
+            tpe_suggest_fused(
+                self._Xdev, self._ydev,
+                len(self._y), count, self._base_key,
+                self._n_choices_dev, self._cont_mask_dev,
+                self.gamma, self.prior_weight, self.full_weight_num,
+                n_cand=self.n_ei_candidates,
+                n_out=num,
+                kmax=self._kmax,
+                equal_weight=self.equal_weight,
             )
         )
-        best = cand[int(np.argmax(scores))]
-        pt = self.cube.untransform(best)
         fid = self.space.fidelity
-        if fid is not None:
-            pt[fid.name] = fid.high
-        return pt
+        out = []
+        for row in best:
+            pt = self.cube.untransform(row)
+            if fid is not None:
+                pt[fid.name] = fid.high
+            out.append(pt)
+        return out
 
     def score(self, point: Dict[str, Any]) -> float:
         """EI score of an arbitrary point under the current l/g fit."""
@@ -224,14 +274,24 @@ class TPE(BaseAlgorithm):
         )
         return float(np.asarray(s)[0])
 
+    def seed_rng(self, seed: Optional[int]) -> None:
+        super().seed_rng(seed)
+        self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
+        self._base_key = None
+        self._suggest_count = 0
+
     # -- persistence -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
         s = super().state_dict()
         s["X"] = [x.tolist() for x in self._X]
         s["y"] = list(self._y)
+        s["suggest_count"] = self._suggest_count
         return s
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         super().load_state_dict(state)
-        self._X = [np.asarray(x) for x in state.get("X", [])]
+        self._X = [np.asarray(x, np.float32) for x in state.get("X", [])]
         self._y = list(state.get("y", []))
+        self._suggest_count = int(state.get("suggest_count", 0))
+        self._cap = 0          # invalidate device mirror
+        self._n_dev = -1

@@ -8,8 +8,11 @@ one (SURVEY.md §3.2: multi-node ≡ same URL).
 
 Connections are per-(process, thread) and lazily rebuilt, so the client
 survives ``fork``/``spawn`` into worker processes and transient coordinator
-restarts (one reconnect attempt per call — safe because every ledger op is
-idempotent or CAS-guarded).
+restarts. Every call carries a unique request id that is REUSED on the
+reconnect retry; the server caches replies by request id, so a request whose
+reply was lost to a connection drop is answered from cache instead of being
+re-executed — this is what makes retrying non-idempotent ops (``reserve``)
+safe.
 """
 
 from __future__ import annotations
@@ -17,6 +20,7 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import uuid
 from typing import Any, Dict, List, Optional
 
 from metaopt_tpu.coord.protocol import recv_msg, send_msg
@@ -80,7 +84,9 @@ class CoordLedgerClient(LedgerBackend):
         self._local.pid_sock = None
 
     def _call(self, op: str, **args: Any) -> Any:
-        msg = {"op": op, "args": args}
+        # one id per logical call, shared by the retry: the server dedups on
+        # it, so "executed but reply lost" cannot double-execute the op
+        msg = {"op": op, "args": args, "req": uuid.uuid4().hex}
         for attempt in (0, 1):
             try:
                 s = self._sock()

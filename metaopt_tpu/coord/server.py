@@ -30,6 +30,7 @@ import os
 import socket
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
 from metaopt_tpu.coord.protocol import ProtocolError, recv_msg, send_msg
@@ -66,11 +67,17 @@ class CoordServer:
         self.event_log_path = event_log_path
 
         self._lock = threading.RLock()
+        self._snap_lock = threading.Lock()  # serializes snapshot file writes
         self._signals: Dict[Tuple[str, str], str] = {}  # (exp, trial_id) → signal
         self._sock: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._stopping = threading.Event()
         self._ops = 0
+        #: reply cache keyed by client request id — answers retries of calls
+        #: whose reply was lost to a dropped connection without re-executing
+        #: them (exactly-once semantics for reserve & co.)
+        self._replies: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._replies_cap = 4096
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -117,8 +124,12 @@ class CoordServer:
     # -- background duties -------------------------------------------------
     def _housekeeping_loop(self) -> None:
         last_snap = time.time()
+        last_sweep = time.time()
         while not self._stopping.wait(min(self.sweep_interval_s, 1.0)):
-            if self.stale_timeout_s is not None:
+            if (
+                self.stale_timeout_s is not None
+                and time.time() - last_sweep >= self.sweep_interval_s
+            ):
                 with self._lock:
                     for name in self.inner.list_experiments():
                         released = self.inner.release_stale(
@@ -126,6 +137,7 @@ class CoordServer:
                         )
                         for t in released:
                             self._event("release_stale", name, trial=t.id)
+                last_sweep = time.time()
             if (
                 self.snapshot_path
                 and time.time() - last_snap >= self.snapshot_interval_s
@@ -154,11 +166,14 @@ class CoordServer:
                     for (e, t), s in self._signals.items()
                 ],
             }
-        tmp = path + ".tmp"
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, path)
+        # the housekeeping thread and stop() may snapshot concurrently; a
+        # shared tmp name would interleave their writes
+        tmp = f"{path}.tmp.{threading.get_ident()}"
+        with self._snap_lock:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)
 
     def restore(self, path: str) -> None:
         with open(path) as f:
@@ -212,15 +227,30 @@ class CoordServer:
                     return
                 if msg is None:
                     return
-                try:
-                    result = self._dispatch(msg.get("op"), msg.get("args") or {})
-                    reply = {"ok": True, "result": result}
-                except Exception as e:  # marshal, don't crash the service
-                    reply = {
-                        "ok": False,
-                        "error": type(e).__name__,
-                        "msg": str(e),
-                    }
+                req = msg.get("req")
+                cached = None
+                if req is not None:
+                    with self._lock:
+                        cached = self._replies.get(req)
+                if cached is not None:
+                    reply = cached
+                else:
+                    try:
+                        result = self._dispatch(
+                            msg.get("op"), msg.get("args") or {}
+                        )
+                        reply = {"ok": True, "result": result}
+                    except Exception as e:  # marshal, don't crash the service
+                        reply = {
+                            "ok": False,
+                            "error": type(e).__name__,
+                            "msg": str(e),
+                        }
+                    if req is not None:
+                        with self._lock:
+                            self._replies[req] = reply
+                            while len(self._replies) > self._replies_cap:
+                                self._replies.popitem(last=False)
                 try:
                     send_msg(conn, reply)
                 except (ConnectionError, BrokenPipeError):
