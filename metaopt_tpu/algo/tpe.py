@@ -234,6 +234,9 @@ class TPE(BaseAlgorithm):
             self._base_key = jax.random.PRNGKey(self._kernel_seed)
         count = self._suggest_count
         self._suggest_count += 1
+        # pad the pool axis to a power of two: the producer's pool size
+        # shrinks near max_trials, and n_out is a static (compile-time) shape
+        n_out = 1 << max(0, num - 1).bit_length()
         best = np.asarray(
             tpe_suggest_fused(
                 self._Xdev, self._ydev,
@@ -241,11 +244,11 @@ class TPE(BaseAlgorithm):
                 self._n_choices_dev, self._cont_mask_dev,
                 self.gamma, self.prior_weight, self.full_weight_num,
                 n_cand=self.n_ei_candidates,
-                n_out=num,
+                n_out=n_out,
                 kmax=self._kmax,
                 equal_weight=self.equal_weight,
             )
-        )
+        )[:num]
         fid = self.space.fidelity
         out = []
         for row in best:

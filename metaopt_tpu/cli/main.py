@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
                       help="TPU chips per trial (enables the TPU executor)")
     hunt.add_argument("--timeout-s", type=float, default=None,
                       help="per-trial wall-clock timeout")
+    hunt.add_argument("--profile-dir", default=None,
+                      help="capture per-trial jax.profiler traces here "
+                           "(scripts opt in with `with client.profiled():`)")
     hunt.add_argument("cmd", nargs=argparse.REMAINDER,
                       help="user script and its args with ~priors")
 
@@ -161,6 +164,7 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
             working_dir=args.working_dir or cfg.get("working_dir"),
             interpreter=interpreter,
             timeout_s=args.timeout_s,
+            profile_dir=args.profile_dir,
         )
     else:
         executor = SubprocessExecutor(
@@ -168,6 +172,7 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
             working_dir=args.working_dir or cfg.get("working_dir"),
             interpreter=interpreter,
             timeout_s=args.timeout_s,
+            profile_dir=args.profile_dir,
         )
 
     worker_id = args.worker_id or f"{os.uname().nodename}-{os.getpid()}"

@@ -86,13 +86,55 @@ def get_trial_info() -> Optional[Dict[str, Any]]:
     return json.loads(raw) if raw else None
 
 
+PROFILE_DIR_ENV = "METAOPT_TPU_PROFILE_DIR"
+
+
+class profiled:
+    """Context manager: capture a ``jax.profiler`` trace of this trial.
+
+    No-op unless the executor injected ``METAOPT_TPU_PROFILE_DIR`` (set
+    ``profile_dir=`` on the executor / ``--profile-dir`` on the CLI). Traces
+    land in ``<profile_dir>/<trial_id>/`` for TensorBoard's profile plugin —
+    the per-trial on-chip observability SURVEY.md §5 calls for.
+
+    Usage inside a user script::
+
+        with client.profiled():
+            for step in range(n):
+                train_step(...)
+    """
+
+    def __init__(self) -> None:
+        base = os.environ.get(PROFILE_DIR_ENV)
+        self._dir: Optional[str] = None
+        if base:
+            info = get_trial_info() or {}
+            self._dir = os.path.join(base, str(info.get("id", os.getpid())))
+
+    def __enter__(self) -> "profiled":
+        if self._dir:
+            import jax
+
+            os.makedirs(self._dir, exist_ok=True)
+            jax.profiler.start_trace(self._dir)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._dir:
+            import jax
+
+            jax.profiler.stop_trace()
+
+
 __all__ = [
     "report_results",
     "report_objective",
     "report_partial",
     "get_trial_info",
+    "profiled",
     "IS_ORCHESTRATED",
     "RESULTS_PATH_ENV",
     "TRIAL_INFO_ENV",
+    "PROFILE_DIR_ENV",
     "ReportError",
 ]

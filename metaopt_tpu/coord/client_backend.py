@@ -7,12 +7,13 @@ exactly as the reference's workers cannot tell a local mongod from a remote
 one (SURVEY.md §3.2: multi-node ≡ same URL).
 
 Connections are per-(process, thread) and lazily rebuilt, so the client
-survives ``fork``/``spawn`` into worker processes and transient coordinator
-restarts. Every call carries a unique request id that is REUSED on the
-reconnect retry; the server caches replies by request id, so a request whose
-reply was lost to a connection drop is answered from cache instead of being
-re-executed — this is what makes retrying non-idempotent ops (``reserve``)
-safe.
+survives ``fork``/``spawn`` into worker processes. Every call carries a
+unique request id that is REUSED on the reconnect retry; the server caches
+replies to mutating ops by request id, so a request whose reply was lost to
+a **connection drop** is answered from cache instead of re-executed — that
+makes retrying non-idempotent ops (``reserve``) safe across drops. A
+coordinator *restart* clears that cache; a reservation orphaned by
+retry-across-restart is reclaimed by the server's stale-heartbeat sweep.
 """
 
 from __future__ import annotations

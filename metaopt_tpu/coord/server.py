@@ -148,28 +148,31 @@ class CoordServer:
     # -- snapshot / restore ------------------------------------------------
     def snapshot(self, path: str) -> None:
         """Backend-agnostic full dump; atomic replace so a crash mid-write
-        never corrupts the previous snapshot."""
-        with self._lock:
-            state = {
-                "version": 1,
-                "ts": time.time(),
-                "experiments": {
-                    name: self.inner.load_experiment(name)
-                    for name in self.inner.list_experiments()
-                },
-                "trials": {
-                    name: [t.to_dict() for t in self.inner.fetch(name)]
-                    for name in self.inner.list_experiments()
-                },
-                "signals": [
-                    {"experiment": e, "trial": t, "signal": s}
-                    for (e, t), s in self._signals.items()
-                ],
-            }
-        # the housekeeping thread and stop() may snapshot concurrently; a
-        # shared tmp name would interleave their writes
-        tmp = f"{path}.tmp.{threading.get_ident()}"
+        never corrupts the previous snapshot.
+
+        ``_snap_lock`` covers capture AND write: the housekeeping thread and
+        ``stop()`` may snapshot concurrently, and interleaving their
+        capture/write phases could commit an older capture last.
+        """
         with self._snap_lock:
+            with self._lock:
+                state = {
+                    "version": 1,
+                    "ts": time.time(),
+                    "experiments": {
+                        name: self.inner.load_experiment(name)
+                        for name in self.inner.list_experiments()
+                    },
+                    "trials": {
+                        name: [t.to_dict() for t in self.inner.fetch(name)]
+                        for name in self.inner.list_experiments()
+                    },
+                    "signals": [
+                        {"experiment": e, "trial": t, "signal": s}
+                        for (e, t), s in self._signals.items()
+                    ],
+                }
+            tmp = path + ".tmp"
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             with open(tmp, "w") as f:
                 json.dump(state, f)
@@ -227,30 +230,7 @@ class CoordServer:
                     return
                 if msg is None:
                     return
-                req = msg.get("req")
-                cached = None
-                if req is not None:
-                    with self._lock:
-                        cached = self._replies.get(req)
-                if cached is not None:
-                    reply = cached
-                else:
-                    try:
-                        result = self._dispatch(
-                            msg.get("op"), msg.get("args") or {}
-                        )
-                        reply = {"ok": True, "result": result}
-                    except Exception as e:  # marshal, don't crash the service
-                        reply = {
-                            "ok": False,
-                            "error": type(e).__name__,
-                            "msg": str(e),
-                        }
-                    if req is not None:
-                        with self._lock:
-                            self._replies[req] = reply
-                            while len(self._replies) > self._replies_cap:
-                                self._replies.popitem(last=False)
+                reply = self._handle(msg)
                 try:
                     send_msg(conn, reply)
                 except (ConnectionError, BrokenPipeError):
@@ -260,6 +240,42 @@ class CoordServer:
                 conn.close()
             except OSError:
                 pass
+
+    #: ops where a blind retry would double-execute; their replies are cached
+    #: by request id. Read-only ops re-execute harmlessly and are not cached
+    #: (a fetch reply on a big experiment is MBs — caching those pins memory).
+    _MUTATING_OPS = frozenset(
+        {"create_experiment", "update_experiment", "register", "reserve",
+         "update_trial", "release_stale", "set_signal"}
+    )
+
+    def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Reply-cache lookup + dispatch + store under ONE lock hold.
+
+        Atomicity matters: a retry arriving while the original request is
+        still executing must block on the lock and then hit the cache —
+        otherwise "reply lost mid-dispatch" double-executes reserve.
+        (Scope: connection drops. A coordinator *restart* clears the cache;
+        orphaned reservations from that path are reclaimed by the stale
+        sweep.)
+        """
+        op = msg.get("op")
+        req = msg.get("req") if op in self._MUTATING_OPS else None
+        with self._lock:
+            if req is not None:
+                cached = self._replies.get(req)
+                if cached is not None:
+                    return cached
+            try:
+                result = self._dispatch(op, msg.get("args") or {})
+                reply = {"ok": True, "result": result}
+            except Exception as e:  # marshal, don't crash the service
+                reply = {"ok": False, "error": type(e).__name__, "msg": str(e)}
+            if req is not None:
+                self._replies[req] = reply
+                while len(self._replies) > self._replies_cap:
+                    self._replies.popitem(last=False)
+            return reply
 
     def _dispatch(self, op: Optional[str], a: Dict[str, Any]) -> Any:
         with self._lock:

@@ -1,0 +1,81 @@
+"""Fault injection for executor tests.
+
+The reference has no fault-injection tooling (SURVEY.md §5: failure handling
+is trial-level statuses only); this module is the build's deliberate
+addition so failure-detection paths — broken trials, lost heartbeats,
+spawn failures, stale-reservation release — are testable deterministically
+instead of waiting for real preemptions.
+
+Usage (tests or chaos runs):
+
+    from metaopt_tpu.executor.faults import faults
+    faults.arm("kill_trial", times=1)        # next trial gets SIGKILLed
+    faults.arm("drop_heartbeat", times=2)    # next 2 heartbeats report lost
+    faults.arm("spawn_fail", times=1)        # next spawn errors out
+
+or via env (picked up at import, for subprocess-launched workers):
+
+    METAOPT_TPU_FAULTS="kill_trial:1,drop_heartbeat:2"
+
+Each armed rule fires ``times`` times then disarms. ``fire(kind)`` is the
+single hook executors consult; it is thread-safe and cheap when nothing is
+armed (one dict lookup).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict
+
+log = logging.getLogger(__name__)
+
+FAULTS_ENV = "METAOPT_TPU_FAULTS"
+
+
+class FaultInjector:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._armed: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+        env = os.environ.get(FAULTS_ENV, "")
+        for part in env.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, n = part.partition(":")
+            self._armed[kind] = int(n) if n else 1
+
+    def arm(self, kind: str, times: int = 1) -> None:
+        with self._lock:
+            self._armed[kind] = self._armed.get(kind, 0) + times
+
+    def fire(self, kind: str) -> bool:
+        """Consume one charge of ``kind``; True = the fault should happen."""
+        if not self._armed:  # fast path: nothing armed anywhere
+            return False
+        with self._lock:
+            n = self._armed.get(kind, 0)
+            if n <= 0:
+                return False
+            if n == 1:
+                del self._armed[kind]
+            else:
+                self._armed[kind] = n - 1
+            self._fired[kind] = self._fired.get(kind, 0) + 1
+        log.warning("fault injected: %s", kind)
+        return True
+
+    def fired(self, kind: str) -> int:
+        with self._lock:
+            return self._fired.get(kind, 0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._armed.clear()
+            self._fired.clear()
+
+
+#: process-global injector — executors consult this instance
+faults = FaultInjector()

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional
 
 from metaopt_tpu.client import RESULTS_PATH_ENV, TRIAL_INFO_ENV
 from metaopt_tpu.executor.base import ExecutionResult, Executor, HeartbeatFn, JudgeFn
+from metaopt_tpu.executor.faults import faults
 from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.space.builder import CommandTemplate
 
@@ -41,6 +42,7 @@ class SubprocessExecutor(Executor):
         heartbeat_every_s: float = 5.0,
         timeout_s: Optional[float] = None,
         extra_env: Optional[Dict[str, str]] = None,
+        profile_dir: Optional[str] = None,
     ):
         self.template = template
         self.working_dir = working_dir
@@ -49,6 +51,8 @@ class SubprocessExecutor(Executor):
         self.heartbeat_every_s = heartbeat_every_s
         self.timeout_s = timeout_s
         self.extra_env = dict(extra_env or {})
+        if profile_dir:  # opt-in per-trial jax.profiler traces (client.profiled)
+            self.extra_env["METAOPT_TPU_PROFILE_DIR"] = profile_dir
 
     # -- env/argv assembly -------------------------------------------------
     def _prepare(self, trial: Trial, tmpdir: str) -> tuple[List[str], Dict[str, str], str]:
@@ -111,6 +115,8 @@ class SubprocessExecutor(Executor):
             # a chatty script once the ~64KB buffer fills
             stdout_path = os.path.join(tmpdir, "stdout")
             stderr_path = os.path.join(tmpdir, "stderr")
+            if faults.fire("spawn_fail"):
+                return ExecutionResult("broken", note="spawn failed: injected")
             try:
                 with open(stdout_path, "wb") as so, open(stderr_path, "wb") as se:
                     proc = subprocess.Popen(
@@ -123,6 +129,9 @@ class SubprocessExecutor(Executor):
                     )
             except OSError as e:
                 return ExecutionResult("broken", note=f"spawn failed: {e}")
+
+            if faults.fire("kill_trial"):  # simulate mid-run preemption
+                self._kill(proc)
 
             partial: List[Dict[str, Any]] = []
             started = time.time()
@@ -141,7 +150,7 @@ class SubprocessExecutor(Executor):
                         )
                     if heartbeat and now - last_beat >= self.heartbeat_every_s:
                         last_beat = now
-                        if not heartbeat():
+                        if faults.fire("drop_heartbeat") or not heartbeat():
                             self._kill(proc)
                             return ExecutionResult(
                                 "interrupted", note="lost reservation"

@@ -197,6 +197,57 @@ class TestConcurrency:
         c._sock().close()  # simulate a dropped connection
         assert c.load_experiment("exp") is not None
 
+    def test_retried_reserve_is_exactly_once(self, server):
+        """A re-delivered request (same req id) must not re-execute the op.
+
+        This is the "reserve executed, reply lost to the connection drop"
+        scenario: the client's retry re-sends with the same request id and
+        must get the SAME trial back, leaving only one reservation.
+        """
+        import socket as _socket
+
+        from metaopt_tpu.coord.protocol import recv_msg, send_msg
+
+        c = _client(server)
+        c.create_experiment({"name": "exp"})
+        c.register(_trial(1.0))
+        c.register(_trial(2.0))
+
+        host, port = server.address
+        msg = {
+            "op": "reserve",
+            "args": {"experiment": "exp", "worker": "w0"},
+            "req": "fixed-req-id",
+        }
+        replies = []
+        for _ in range(2):  # two deliveries on two fresh connections
+            s = _socket.create_connection((host, port))
+            send_msg(s, msg)
+            replies.append(recv_msg(s))
+            s.close()
+        assert replies[0]["ok"] and replies[1]["ok"]
+        assert replies[0]["result"]["id"] == replies[1]["result"]["id"]
+        reserved = [t for t in c.fetch("exp") if t.status == "reserved"]
+        assert len(reserved) == 1
+
+    def test_concurrent_snapshots_never_corrupt(self, server, tmp_path):
+        snap = str(tmp_path / "snap.json")
+        c = _client(server)
+        c.create_experiment({"name": "exp"})
+        for i in range(20):
+            c.register(_trial(float(i)))
+
+        threads = [
+            threading.Thread(target=server.snapshot, args=(snap,))
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        state = json.load(open(snap))  # must parse — no interleaved writes
+        assert len(state["trials"]["exp"]) == 20
+
 
 class TestPodGlue:
     def test_single_process_pod_coordinator(self, tmp_path):

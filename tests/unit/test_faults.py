@@ -1,0 +1,103 @@
+"""Fault-injection tests: the failure-detection paths, deterministically.
+
+SURVEY.md §5 calls for a fault-injection hook in the executor so broken
+trials, lost heartbeats, and spawn failures are testable without real
+preemptions. These tests drive the real SubprocessExecutor through each
+injected fault and assert the worker-loop-visible outcome.
+"""
+
+import os
+import sys
+
+import pytest
+
+from metaopt_tpu.executor.faults import FaultInjector, faults
+from metaopt_tpu.executor.subproc import SubprocessExecutor
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space import SpaceBuilder
+
+BLACK_BOX = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "functional",
+    "black_box.py",
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_executor(**kw):
+    _, template = SpaceBuilder().build([BLACK_BOX, "-x~uniform(-5, 5)"])
+    t = Trial(params={"x": 0.5}, experiment="e")
+    t.transition("reserved")
+    return t, SubprocessExecutor(template, interpreter=[sys.executable], **kw)
+
+
+class TestInjector:
+    def test_fire_consumes_charges(self):
+        inj = FaultInjector()
+        inj.arm("kill_trial", times=2)
+        assert inj.fire("kill_trial")
+        assert inj.fire("kill_trial")
+        assert not inj.fire("kill_trial")
+        assert inj.fired("kill_trial") == 2
+
+    def test_unarmed_is_free(self):
+        inj = FaultInjector()
+        assert not inj.fire("anything")
+
+    def test_env_arming(self, monkeypatch):
+        monkeypatch.setenv("METAOPT_TPU_FAULTS", "spawn_fail:3, kill_trial")
+        inj = FaultInjector()
+        assert inj.fire("kill_trial")
+        assert not inj.fire("kill_trial")
+        assert all(inj.fire("spawn_fail") for _ in range(3))
+
+
+class TestExecutorFaults:
+    def test_spawn_fail_breaks_trial(self):
+        trial, ex = make_executor()
+        faults.arm("spawn_fail")
+        res = ex.execute(trial)
+        assert res.status == "broken"
+        assert "injected" in res.note
+
+    def test_kill_trial_breaks_then_recovers(self):
+        trial, ex = make_executor()
+        faults.arm("kill_trial")
+        res = ex.execute(trial)
+        assert res.status == "broken"
+        # next trial (no fault armed) completes normally
+        trial2 = Trial(params={"x": 0.25}, experiment="e")
+        trial2.transition("reserved")
+        res2 = ex.execute(trial2)
+        assert res2.status == "completed"
+        assert res2.results[0]["value"] == pytest.approx(0.5625)
+
+    def test_drop_heartbeat_interrupts_slow_trial(self, tmp_path):
+        sleeper = tmp_path / "sleeper.py"
+        sleeper.write_text(
+            "import time, argparse\n"
+            "p = argparse.ArgumentParser(); p.add_argument('-x', type=float)\n"
+            "p.parse_args()\n"
+            "time.sleep(30)\n"
+        )
+        space, template = SpaceBuilder().build(
+            [str(sleeper), "-x~uniform(-5, 5)"]
+        )
+        trial = Trial(params={"x": 1.0}, experiment="e")
+        trial.transition("reserved")
+        ex = SubprocessExecutor(
+            template,
+            interpreter=[sys.executable],
+            heartbeat_every_s=0.05,
+            poll_interval_s=0.02,
+        )
+        faults.arm("drop_heartbeat")
+        res = ex.execute(trial, heartbeat=lambda: True)
+        assert res.status == "interrupted"
+        assert "lost reservation" in res.note
