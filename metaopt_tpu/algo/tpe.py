@@ -236,7 +236,7 @@ class TPE(BaseAlgorithm):
         self._suggest_count += 1
         # pad the pool axis to a power of two: the producer's pool size
         # shrinks near max_trials, and n_out is a static (compile-time) shape
-        n_out = 1 << max(0, num - 1).bit_length()
+        n_out = pad_pow2(num, minimum=1)
         best = np.asarray(
             tpe_suggest_fused(
                 self._Xdev, self._ydev,

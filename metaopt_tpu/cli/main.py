@@ -105,6 +105,8 @@ def _make_ledger_from_spec(spec: Optional[str], cfg: Dict[str, Any]):
     if spec.startswith("coord://"):
         host, _, port = spec[len("coord://"):].partition(":")
         return make_ledger({"type": "coord", "host": host, "port": int(port or 0)})
+    if spec.startswith("native:"):
+        return make_ledger({"type": "native", "path": spec[len("native:"):]})
     return make_ledger({"type": "file", "path": spec})
 
 

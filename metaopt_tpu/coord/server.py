@@ -260,6 +260,19 @@ class CoordServer:
         sweep.)
         """
         op = msg.get("op")
+        if op == "snapshot":
+            # dispatched OUTSIDE _lock: snapshot() takes _snap_lock → _lock
+            # itself, and taking _lock first here would deadlock AB-BA
+            # against the housekeeping/stop() snapshot path
+            try:
+                a = msg.get("args") or {}
+                path = a.get("path") or self.snapshot_path
+                if not path:
+                    raise ValueError("no snapshot path configured")
+                self.snapshot(path)
+                return {"ok": True, "result": path}
+            except Exception as e:
+                return {"ok": False, "error": type(e).__name__, "msg": str(e)}
         req = msg.get("req") if op in self._MUTATING_OPS else None
         with self._lock:
             if req is not None:
@@ -344,13 +357,7 @@ class CoordServer:
                     trial=a["trial_id"], signal=a["signal"],
                 )
                 return None
-            if op == "snapshot":
-                path = a.get("path") or self.snapshot_path
-                if not path:
-                    raise ValueError("no snapshot path configured")
-                self.snapshot(path)
-                return path
-            raise ValueError(f"unknown op: {op!r}")
+            raise ValueError(f"unknown op: {op!r}")  # (snapshot: see _handle)
 
 
 def serve_forever(server: CoordServer) -> None:

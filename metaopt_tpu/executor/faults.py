@@ -45,7 +45,11 @@ class FaultInjector:
             if not part:
                 continue
             kind, _, n = part.partition(":")
-            self._armed[kind] = int(n) if n else 1
+            try:
+                self._armed[kind] = int(n) if n else 1
+            except ValueError:
+                # a chaos-test env typo must not kill the worker at import
+                log.warning("ignoring malformed %s entry %r", FAULTS_ENV, part)
 
     def arm(self, kind: str, times: int = 1) -> None:
         with self._lock:

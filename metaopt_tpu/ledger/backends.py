@@ -400,4 +400,6 @@ def make_ledger(config: Dict[str, Any]) -> LedgerBackend:
                 "the 'coord' ledger backend requires the coordinator service "
                 f"(metaopt_tpu.coord): {e}"
             ) from None
+    elif kind == "native":  # lazy: only compiles/loads the engine on use
+        from metaopt_tpu.ledger.native import NativeFileLedger  # noqa: F401
     return ledger_registry.get(kind)(**cfg)

@@ -1,0 +1,199 @@
+"""NativeFileLedger — file ledger on the C++ ledgerstore engine.
+
+Same on-disk-coordination role as :class:`FileLedger` (the reference's
+MongoDB stand-in, SURVEY.md §2.4), but the trial hot path — register,
+reserve CAS, heartbeat, stale sweep — runs in the native engine
+(``metaopt_tpu/native/ledgerstore.cpp``): an append-only record log with an
+in-memory index, every op serialized by an exclusive flock with log-tail
+replay. A heartbeat appends ~40 bytes instead of rewriting a JSON document,
+and reserve scans an index instead of re-reading every trial file.
+
+Division of authority: the engine owns (status, worker, heartbeat) — the
+fields concurrency is fought over — while the full trial document rides
+along as an opaque JSON payload. Reads overlay the engine's fields onto the
+payload so a stale payload status can never win. Experiment documents are
+low-rate and stay on the inherited FileLedger JSON path.
+
+Falls back never: constructing this backend without a working toolchain
+raises, and ``make_ledger({"type": "file"})`` keeps using the pure-Python
+backend. Use ``{"type": "native"}`` (CLI: ``--ledger native:<dir>``) to
+opt in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from metaopt_tpu.ledger.backends import (
+    DuplicateTrialError,
+    FileLedger,
+    ledger_registry,
+)
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.native import load_ledgerstore
+
+
+def _safe(s: str) -> bytes:
+    """Engine string fields must stay quote/backslash-free (envelope
+    contract documented in ledgerstore.cpp). Reject, never strip: silently
+    sanitizing could collide two distinct worker/trial identifiers and
+    break the exactly-one-owner guarantee."""
+    if '"' in s or "\\" in s:
+        raise ValueError(
+            f"ledger identifier {s!r} may not contain quotes or backslashes"
+        )
+    return s.encode()
+
+
+@ledger_registry.register("native")
+class NativeFileLedger(FileLedger):
+    def __init__(self, path: Optional[str] = None, **kw: Any) -> None:
+        super().__init__(path, **kw)
+        self._lib = load_ledgerstore()
+        if self._lib is None:
+            raise RuntimeError(
+                "native ledgerstore unavailable (no g++?); "
+                "use the 'file' backend instead"
+            )
+        # (pid, experiment) → engine handle: a handle's flock fd must never
+        # be shared across fork (both sides would believe they hold the lock)
+        self._handles: Dict[tuple, int] = {}
+        self._hlock = threading.Lock()
+
+    # -- engine plumbing ---------------------------------------------------
+    def _handle(self, experiment: str) -> tuple:
+        """(handle, per-handle lock). flock is per open-file-description, so
+        threads sharing a handle must also serialize in-process."""
+        key = (os.getpid(), experiment)
+        with self._hlock:
+            ent = self._handles.get(key)
+            if ent is None:
+                sdir = os.path.join(self._edir(experiment), "store")
+                os.makedirs(os.path.dirname(sdir), exist_ok=True)
+                h = self._lib.ls_open(sdir.encode())
+                if not h:
+                    raise RuntimeError(f"ledgerstore open failed: {sdir}")
+                ent = (h, threading.Lock())
+                self._handles[key] = ent
+            return ent
+
+    def _take(self, ptr) -> str:
+        """Copy + free a malloc'd engine string."""
+        if not ptr:
+            return ""
+        try:
+            import ctypes
+
+            return ctypes.string_at(ptr).decode()
+        finally:
+            self._lib.ls_free(ptr)
+
+    @staticmethod
+    def _status_csv(status) -> bytes:
+        if status is None:
+            return b""
+        if isinstance(status, str):
+            return status.encode()
+        return ",".join(status).encode()
+
+    @staticmethod
+    def _from_envelope(env: Dict[str, Any]) -> Trial:
+        """Trial from payload with the engine's authoritative overlay."""
+        doc = env["payload"] or {}
+        doc["status"] = env["status"]
+        hb = env["heartbeat"]
+        doc["heartbeat"] = hb if hb > 0 else None
+        if env["status"] == "reserved":
+            doc["worker"] = env["worker"] or None
+        return Trial.from_dict(doc)
+
+    # -- trial ops on the engine ------------------------------------------
+    def register(self, trial: Trial) -> None:
+        h, lk = self._handle(trial.experiment)
+        payload = json.dumps(trial.to_dict()).encode()
+        with lk:
+            rc = self._lib.ls_put(
+                h, _safe(trial.id), _safe(trial.status), payload,
+                float(trial.submit_time or 0.0),
+            )
+        if rc == 1:
+            raise DuplicateTrialError(trial.id)
+        if rc != 0:
+            raise RuntimeError(f"ledgerstore put failed ({rc})")
+
+    def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
+        h, lk = self._handle(experiment)
+        with lk:
+            raw = self._take(self._lib.ls_reserve(h, _safe(worker)))
+        if not raw:
+            return None
+        t = self._from_envelope(json.loads(raw))
+        t.worker = worker
+        t.start_time = t.heartbeat
+        return t
+
+    def update_trial(
+        self,
+        trial: Trial,
+        expected_status: Optional[str] = None,
+        expected_worker: Optional[str] = None,
+    ) -> bool:
+        h, lk = self._handle(trial.experiment)
+        payload = json.dumps(trial.to_dict()).encode()
+        with lk:
+            rc = self._lib.ls_cas(
+                h,
+                _safe(trial.id),
+                _safe(expected_status or ""),
+                _safe(expected_worker or ""),
+                _safe(trial.status),
+                _safe(trial.worker or ""),
+                payload,
+                float(trial.heartbeat or 0.0),
+            )
+        return rc == 0
+
+    def heartbeat(self, experiment: str, trial_id: str, worker: str) -> bool:
+        h, lk = self._handle(experiment)
+        with lk:
+            return self._lib.ls_heartbeat(h, _safe(trial_id), _safe(worker)) == 0
+
+    def get(self, experiment: str, trial_id: str) -> Optional[Trial]:
+        h, lk = self._handle(experiment)
+        with lk:
+            raw = self._take(self._lib.ls_get(h, _safe(trial_id)))
+        return self._from_envelope(json.loads(raw)) if raw else None
+
+    def fetch(self, experiment: str, status=None) -> List[Trial]:
+        h, lk = self._handle(experiment)
+        with lk:
+            raw = self._take(self._lib.ls_fetch(h, self._status_csv(status)))
+        out = [
+            self._from_envelope(json.loads(line))
+            for line in raw.splitlines()
+            if line
+        ]
+        out.sort(key=lambda t: (t.submit_time or 0, t.id))
+        return out
+
+    def count(self, experiment: str, status=None) -> int:
+        h, lk = self._handle(experiment)
+        with lk:
+            return int(self._lib.ls_count(h, self._status_csv(status)))
+
+    def release_stale(self, experiment: str, timeout_s: float) -> List[Trial]:
+        h, lk = self._handle(experiment)
+        with lk:
+            raw = self._take(self._lib.ls_release_stale(h, float(timeout_s)))
+        out = []
+        for line in raw.splitlines():
+            if not line:
+                continue
+            t = self._from_envelope(json.loads(line))
+            t.worker = None
+            t.start_time = None
+            out.append(t)
+        return out

@@ -1,0 +1,107 @@
+"""Native components: build-on-demand C++ pieces of the runtime.
+
+The reference is pure Python over pymongo (SURVEY.md §2.9 — no native
+inventory to port); the native work in this build is deliberate new
+engineering where it buys real throughput. Currently: the ledgerstore
+storage engine (``ledgerstore.cpp``) backing
+:class:`~metaopt_tpu.ledger.native.NativeFileLedger`.
+
+The shared library is compiled on first use with the system ``g++`` (baked
+into the image) and cached next to the source; environments without a
+toolchain simply get ``load_ledgerstore() -> None`` and the pure-Python
+backends keep working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "ledgerstore.cpp")
+_SO = os.path.join(_DIR, "libledgerstore.so")
+_BUILD_LOCK = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _compile() -> bool:
+    # per-process tmp name: concurrent first-use builds in sibling worker
+    # processes must not interleave writes into one tmp file
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+        if proc.returncode != 0:
+            log.warning("ledgerstore build failed:\n%s", proc.stderr[-2000:])
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.info("ledgerstore build unavailable: %s", e)
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_char_p = ctypes.c_char_p
+    lib.ls_open.restype = ctypes.c_void_p
+    lib.ls_open.argtypes = [c_char_p]
+    lib.ls_close.argtypes = [ctypes.c_void_p]
+    lib.ls_put.restype = ctypes.c_int
+    lib.ls_put.argtypes = [
+        ctypes.c_void_p, c_char_p, c_char_p, c_char_p, ctypes.c_double,
+    ]
+    lib.ls_cas.restype = ctypes.c_int
+    lib.ls_cas.argtypes = (
+        [ctypes.c_void_p] + [c_char_p] * 6 + [ctypes.c_double]
+    )
+    # char* returns are void_p so we can free them (c_char_p auto-converts
+    # and leaks the buffer)
+    for fn in ("ls_reserve", "ls_get", "ls_fetch", "ls_release_stale"):
+        getattr(lib, fn).restype = ctypes.c_void_p
+    lib.ls_reserve.argtypes = [ctypes.c_void_p, c_char_p]
+    lib.ls_get.argtypes = [ctypes.c_void_p, c_char_p]
+    lib.ls_fetch.argtypes = [ctypes.c_void_p, c_char_p]
+    lib.ls_release_stale.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.ls_heartbeat.restype = ctypes.c_int
+    lib.ls_heartbeat.argtypes = [ctypes.c_void_p, c_char_p, c_char_p]
+    lib.ls_count.restype = ctypes.c_long
+    lib.ls_count.argtypes = [ctypes.c_void_p, c_char_p]
+    lib.ls_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load_ledgerstore():
+    """The bound CDLL, building it if needed; None when unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _BUILD_LOCK:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not _compile():
+                _load_failed = True
+                return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError as e:
+            log.warning("ledgerstore load failed: %s", e)
+            _load_failed = True
+    return _lib

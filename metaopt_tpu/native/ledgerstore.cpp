@@ -1,0 +1,452 @@
+// ledgerstore — native storage engine for the trial ledger.
+//
+// Role (SURVEY.md §2.4): the reference delegates trial persistence +
+// atomic reservation to MongoDB's storage engine and find_one_and_update.
+// This is the TPU build's native equivalent for the file-backed ledger: an
+// append-only record log per experiment with an in-memory index, where
+// every mutation is serialized by an exclusive flock and readers replay the
+// log tail before acting — multi-process linearizable CAS without a
+// database server. The Python FileLedger rewrites a JSON file per trial
+// mutation; this engine appends one small record instead (heartbeats are
+// ~40 bytes, not a full document rewrite).
+//
+// Layering: the engine is deliberately payload-agnostic. It owns the
+// concurrency-critical fields (key, status, worker, heartbeat) and treats
+// the trial document as opaque bytes supplied by Python. Keys/statuses/
+// workers must not contain '"' or '\\' (they are hex ids and enum strings;
+// the Python wrapper enforces this) so envelopes can be emitted without a
+// JSON library.
+//
+// Log format, little-endian:
+//   magic "MTPULDG1" (8 bytes), then records:
+//   u32 body_len | u8 op | u16 key_len,key | u16 status_len,status |
+//   u16 worker_len,worker | f64 heartbeat | u32 payload_len,payload
+//   op: 1=put (insert-only)  2=set (status/worker/hb + payload)
+//       3=mark (status/worker/hb, payload unchanged)  4=beat (hb only)
+// A torn tail record (crash mid-write) is detected by body_len overrunning
+// EOF and ignored; the next writer truncates it.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 ledgerstore.cpp -o libledgerstore.so
+
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[8] = {'M', 'T', 'P', 'U', 'L', 'D', 'G', '1'};
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<double>(ts.tv_sec) + ts.tv_nsec * 1e-9;
+}
+
+struct Entry {
+  std::string status;
+  std::string worker;
+  double heartbeat = 0.0;
+  double order = 0.0;  // client-supplied sort key (submit time): FIFO reserve
+  std::string payload;
+};
+
+struct Record {
+  uint8_t op;
+  std::string key, status, worker, payload;
+  double heartbeat;
+};
+
+class Store {
+ public:
+  explicit Store(const std::string& dir) : dir_(dir) {
+    ::mkdir(dir.c_str(), 0777);
+    lock_fd_ = ::open((dir + "/lock").c_str(), O_CREAT | O_RDWR, 0666);
+    log_fd_ = ::open((dir + "/trials.log").c_str(),
+                     O_CREAT | O_RDWR | O_APPEND, 0666);
+    if (lock_fd_ >= 0 && log_fd_ >= 0) {
+      // magic init under the lock: two processes first-opening the store
+      // must not both append it (it would desync byte accounting)
+      ::flock(lock_fd_, LOCK_EX);
+      struct stat st;
+      if (fstat(log_fd_, &st) == 0 && st.st_size == 0) {
+        ::write(log_fd_, kMagic, sizeof(kMagic));
+      }
+      ::flock(lock_fd_, LOCK_UN);
+      applied_ = sizeof(kMagic);
+    }
+  }
+
+  ~Store() {
+    if (lock_fd_ >= 0) ::close(lock_fd_);
+    if (log_fd_ >= 0) ::close(log_fd_);
+  }
+
+  bool ok() const { return lock_fd_ >= 0 && log_fd_ >= 0; }
+
+  // ---- locked section helpers ----
+  class Guard {
+   public:
+    explicit Guard(Store* s) : s_(s) {
+      ::flock(s_->lock_fd_, LOCK_EX);
+      s_->replay_tail();
+    }
+    ~Guard() { ::flock(s_->lock_fd_, LOCK_UN); }
+
+   private:
+    Store* s_;
+  };
+
+  // ---- ops (each takes the lock itself) ----
+  // sort_key (the trial's submit time) fixes FIFO reserve order across
+  // processes that register out of order. Travels in the record's hb slot.
+  int put(const char* key, const char* status, const char* payload,
+          double sort_key) {
+    Guard g(this);
+    if (index_.count(key)) return 1;  // duplicate
+    Record r{1, key, status, "", payload, sort_key};
+    if (!append(r)) return -1;
+    apply(r);
+    return 0;
+  }
+
+  // CAS update: expected_* of "" means "don't check".
+  // new_worker/new_payload of "" mean "keep". hb is caller-supplied (the
+  // updated document is authoritative, e.g. a backdated heartbeat in
+  // tests). Returns 0 ok, 1 cas-fail, 2 missing key.
+  int cas(const char* key, const char* exp_status, const char* exp_worker,
+          const char* new_status, const char* new_worker,
+          const char* new_payload, double hb) {
+    Guard g(this);
+    auto it = index_.find(key);
+    if (it == index_.end()) return 2;
+    if (exp_status[0] && it->second.status != exp_status) return 1;
+    if (exp_worker[0] && it->second.worker != exp_worker) return 1;
+    Record r{static_cast<uint8_t>(new_payload[0] ? 2 : 3),
+             key,
+             new_status[0] ? new_status : it->second.status,
+             new_worker[0] ? new_worker : it->second.worker,
+             new_payload,
+             hb};
+    if (!append(r)) return -1;
+    apply(r);
+    return 0;
+  }
+
+  // Reserve the oldest 'new' entry (min (sort_key, key)): status →
+  // reserved, stamp worker + hb. Returns envelope or "".
+  std::string reserve(const char* worker) {
+    Guard g(this);
+    const std::string* best = nullptr;
+    const Entry* best_e = nullptr;
+    for (const auto& key : order_) {
+      auto it = index_.find(key);
+      if (it == index_.end() || it->second.status != "new") continue;
+      const Entry& e = it->second;
+      if (!best || e.order < best_e->order ||
+          (e.order == best_e->order && key < *best)) {
+        best = &it->first;
+        best_e = &e;
+      }
+    }
+    if (!best) return "";
+    Record r{3, *best, "reserved", worker, "", now_s()};
+    if (!append(r)) return "";
+    apply(r);
+    return envelope(*best, index_.at(*best));
+  }
+
+  int beat(const char* key, const char* worker) {
+    Guard g(this);
+    auto it = index_.find(key);
+    if (it == index_.end() || it->second.status != "reserved" ||
+        it->second.worker != worker)
+      return 1;
+    Record r{4, key, "", "", "", now_s()};
+    if (!append(r)) return -1;
+    apply(r);
+    return 0;
+  }
+
+  std::string release_stale(double timeout_s) {
+    Guard g(this);
+    const double cutoff = now_s() - timeout_s;
+    std::string out;
+    for (const auto& key : order_) {
+      auto it = index_.find(key);
+      if (it == index_.end() || it->second.status != "reserved" ||
+          it->second.heartbeat >= cutoff)
+        continue;
+      Record r{3, key, "new", "", "", 0.0};
+      if (!append(r)) break;
+      apply(r);
+      out += envelope(key, it->second);  // post-release: status back to 'new'
+      out += '\n';
+    }
+    return out;
+  }
+
+  std::string get(const char* key) {
+    Guard g(this);
+    auto it = index_.find(key);
+    if (it == index_.end()) return "";
+    return envelope(it->first, it->second);
+  }
+
+  std::string fetch(const char* status_csv) {
+    Guard g(this);
+    std::vector<std::string> wanted = split_csv(status_csv);
+    std::string out;
+    for (const auto& key : order_) {
+      auto it = index_.find(key);
+      if (it == index_.end()) continue;
+      if (!wanted.empty() && !contains(wanted, it->second.status)) continue;
+      out += envelope(key, it->second);
+      out += '\n';
+    }
+    return out;
+  }
+
+  long count(const char* status_csv) {
+    Guard g(this);
+    std::vector<std::string> wanted = split_csv(status_csv);
+    long n = 0;
+    for (const auto& key : order_) {
+      auto it = index_.find(key);
+      if (it == index_.end()) continue;
+      if (wanted.empty() || contains(wanted, it->second.status)) ++n;
+    }
+    return n;
+  }
+
+ private:
+  static std::vector<std::string> split_csv(const char* csv) {
+    std::vector<std::string> out;
+    if (!csv || !csv[0]) return out;
+    const char* p = csv;
+    while (*p) {
+      const char* q = strchr(p, ',');
+      if (!q) q = p + strlen(p);
+      if (q > p) out.emplace_back(p, q - p);
+      p = *q ? q + 1 : q;
+    }
+    return out;
+  }
+
+  static bool contains(const std::vector<std::string>& v,
+                       const std::string& s) {
+    for (const auto& x : v)
+      if (x == s) return true;
+    return false;
+  }
+
+  std::string envelope(const std::string& key, const Entry& e) const {
+    // key/status/worker are quote/backslash-free by wrapper contract;
+    // payload is raw JSON and embedded verbatim.
+    std::string out = "{\"key\":\"" + key + "\",\"status\":\"" + e.status +
+                      "\",\"worker\":\"" + e.worker + "\",\"heartbeat\":";
+    char buf[32];
+    snprintf(buf, sizeof(buf), "%.6f", e.heartbeat);
+    out += buf;
+    out += ",\"payload\":";
+    out += e.payload.empty() ? "null" : e.payload;
+    out += "}";
+    return out;
+  }
+
+  // ---- log IO ----
+  static void put_u16(std::string& b, uint16_t v) {
+    b.append(reinterpret_cast<const char*>(&v), 2);
+  }
+  static void put_u32(std::string& b, uint32_t v) {
+    b.append(reinterpret_cast<const char*>(&v), 4);
+  }
+  static void put_str16(std::string& b, const std::string& s) {
+    put_u16(b, static_cast<uint16_t>(s.size()));
+    b += s;
+  }
+
+  bool append(const Record& r) {
+    std::string body;
+    body.push_back(static_cast<char>(r.op));
+    put_str16(body, r.key);
+    put_str16(body, r.status);
+    put_str16(body, r.worker);
+    body.append(reinterpret_cast<const char*>(&r.heartbeat), 8);
+    put_u32(body, static_cast<uint32_t>(r.payload.size()));
+    body += r.payload;
+
+    std::string rec;
+    put_u32(rec, static_cast<uint32_t>(body.size()));
+    rec += body;
+    ssize_t n = ::write(log_fd_, rec.data(), rec.size());
+    if (n != static_cast<ssize_t>(rec.size())) return false;
+    applied_ += rec.size();
+    return true;
+  }
+
+  void apply(const Record& r) {
+    if (r.op == 1) {
+      if (index_.count(r.key)) return;  // insert-only
+      index_[r.key] = Entry{r.status, r.worker, 0.0, r.heartbeat, r.payload};
+      order_.push_back(r.key);
+      return;
+    }
+    auto it = index_.find(r.key);
+    if (it == index_.end()) return;  // mark/beat for unknown key: ignore
+    Entry& e = it->second;
+    if (r.op == 2) {
+      e.status = r.status;
+      e.worker = r.worker;
+      e.heartbeat = r.heartbeat;
+      e.payload = r.payload;
+    } else if (r.op == 3) {
+      if (!r.status.empty()) e.status = r.status;
+      e.worker = r.worker;
+      e.heartbeat = r.heartbeat;
+    } else if (r.op == 4) {
+      e.heartbeat = r.heartbeat;
+    }
+  }
+
+  // Replay records other processes appended since our last look. Truncates
+  // a torn tail (crash mid-write) so the log stays parseable.
+  void replay_tail() {
+    struct stat st;
+    if (fstat(log_fd_, &st) != 0) return;
+    if (static_cast<off_t>(applied_) >= st.st_size) return;
+    size_t len = st.st_size - applied_;
+    std::string buf(len, '\0');
+    ssize_t n = ::pread(log_fd_, buf.data(), len, applied_);
+    if (n < 0) return;
+    buf.resize(n);
+
+    size_t pos = 0;
+    while (pos + 4 <= buf.size()) {
+      uint32_t body_len;
+      memcpy(&body_len, buf.data() + pos, 4);
+      if (pos + 4 + body_len > buf.size()) {
+        // torn tail — drop it (holder of the exclusive lock may truncate)
+        if (::ftruncate(log_fd_, applied_ + pos) == 0) {
+          applied_ += pos;
+          return;
+        }
+        break;
+      }
+      const char* p = buf.data() + pos + 4;
+      const char* end = p + body_len;
+      Record r;
+      if (!parse(p, end, &r)) break;
+      apply(r);
+      pos += 4 + body_len;
+    }
+    applied_ += pos;
+  }
+
+  static bool get_str16(const char*& p, const char* end, std::string* out) {
+    if (p + 2 > end) return false;
+    uint16_t n;
+    memcpy(&n, p, 2);
+    p += 2;
+    if (p + n > end) return false;
+    out->assign(p, n);
+    p += n;
+    return true;
+  }
+
+  static bool parse(const char* p, const char* end, Record* r) {
+    if (p >= end) return false;
+    r->op = static_cast<uint8_t>(*p++);
+    if (!get_str16(p, end, &r->key) || !get_str16(p, end, &r->status) ||
+        !get_str16(p, end, &r->worker))
+      return false;
+    if (p + 8 > end) return false;
+    memcpy(&r->heartbeat, p, 8);
+    p += 8;
+    if (p + 4 > end) return false;
+    uint32_t plen;
+    memcpy(&plen, p, 4);
+    p += 4;
+    if (p + plen > end) return false;
+    r->payload.assign(p, plen);
+    return true;
+  }
+
+  std::string dir_;
+  int lock_fd_ = -1;
+  int log_fd_ = -1;
+  size_t applied_ = 0;  // log bytes reflected in the index
+  std::unordered_map<std::string, Entry> index_;
+  std::vector<std::string> order_;  // insertion order, for FIFO reserve
+};
+
+char* dup_or_null(const std::string& s) {
+  if (s.empty()) return nullptr;
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  if (out) memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ls_open(const char* dir) {
+  Store* s = new Store(dir);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void ls_close(void* h) { delete static_cast<Store*>(h); }
+
+int ls_put(void* h, const char* key, const char* status, const char* payload,
+           double sort_key) {
+  return static_cast<Store*>(h)->put(key, status, payload, sort_key);
+}
+
+int ls_cas(void* h, const char* key, const char* exp_status,
+           const char* exp_worker, const char* new_status,
+           const char* new_worker, const char* new_payload, double hb) {
+  return static_cast<Store*>(h)->cas(key, exp_status, exp_worker, new_status,
+                                     new_worker, new_payload, hb);
+}
+
+char* ls_reserve(void* h, const char* worker) {
+  return dup_or_null(static_cast<Store*>(h)->reserve(worker));
+}
+
+int ls_heartbeat(void* h, const char* key, const char* worker) {
+  return static_cast<Store*>(h)->beat(key, worker);
+}
+
+char* ls_release_stale(void* h, double timeout_s) {
+  return dup_or_null(static_cast<Store*>(h)->release_stale(timeout_s));
+}
+
+char* ls_get(void* h, const char* key) {
+  return dup_or_null(static_cast<Store*>(h)->get(key));
+}
+
+char* ls_fetch(void* h, const char* status_csv) {
+  return dup_or_null(static_cast<Store*>(h)->fetch(status_csv));
+}
+
+long ls_count(void* h, const char* status_csv) {
+  return static_cast<Store*>(h)->count(status_csv);
+}
+
+void ls_free(char* p) { free(p); }
+
+}  // extern "C"

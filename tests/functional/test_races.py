@@ -29,11 +29,20 @@ def _worker(ledger_cfg: dict, worker_id: str, out_path: str) -> None:
         json.dump({"completed": stats.completed, "events": stats.events}, f)
 
 
-def test_four_workers_no_double_execution(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("backend", ["file", "native"])
+def test_four_workers_no_double_execution(tmp_path, backend):
+    if backend == "native":
+        from metaopt_tpu.native import load_ledgerstore
+
+        if load_ledgerstore() is None:
+            pytest.skip("no toolchain for the native ledgerstore")
     ledger_dir = str(tmp_path / "ledger")
     space = build_space({"x": "uniform(-5, 5)"})
     Experiment(
-        "race", make_ledger({"type": "file", "path": ledger_dir}),
+        "race", make_ledger({"type": backend, "path": ledger_dir}),
         space=space, max_trials=24, pool_size=4,
         algorithm={"random": {"seed": 9}},
     ).configure()
@@ -43,7 +52,7 @@ def test_four_workers_no_double_execution(tmp_path):
     procs = [
         ctx.Process(
             target=_worker,
-            args=({"type": "file", "path": ledger_dir}, f"w{i}", outs[i]),
+            args=({"type": backend, "path": ledger_dir}, f"w{i}", outs[i]),
         )
         for i in range(4)
     ]
@@ -60,7 +69,7 @@ def test_four_workers_no_double_execution(tmp_path):
     assert total == 24
 
     exp = Experiment(
-        "race", make_ledger({"type": "file", "path": ledger_dir})
+        "race", make_ledger({"type": backend, "path": ledger_dir})
     ).configure()
     assert exp.count("completed") == 24
     assert exp.is_done

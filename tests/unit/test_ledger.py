@@ -18,12 +18,19 @@ from metaopt_tpu.ledger import (
 from metaopt_tpu.ledger.backends import DuplicateExperimentError
 
 
-@pytest.fixture(params=["memory", "file", "coord"])
+@pytest.fixture(params=["memory", "file", "native", "coord"])
 def ledger(request, tmp_path):
     if request.param == "memory":
         return MemoryLedger()
     if request.param == "file":
         return FileLedger(path=str(tmp_path / "ledger"))
+    if request.param == "native":
+        from metaopt_tpu.ledger.native import NativeFileLedger
+        from metaopt_tpu.native import load_ledgerstore
+
+        if load_ledgerstore() is None:
+            pytest.skip("no toolchain for the native ledgerstore")
+        return NativeFileLedger(path=str(tmp_path / "ledger"))
     from metaopt_tpu.coord import CoordLedgerClient, CoordServer
 
     server = CoordServer().start()
