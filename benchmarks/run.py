@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Run the five BASELINE graded configs end-to-end and report throughput.
+
+BASELINE.md's graded configs, each driven through the real CLI exactly as a
+user would run it (subprocess trials, ~prior DSL, ledger on disk):
+
+  1. random   × Rosenbrock-2D        (CPU objective)
+  2. tpe      × MLP/MNIST-shaped     (single chip)
+  3. asha     × ResNet/CIFAR-shaped  (multi-fidelity, partial streaming)
+  4. hyperband× Transformer seq2seq  (sub-slice shardable)
+  5. evolution× PPO                  (population search)
+
+Default is smoke scale (completes in minutes, CPU-friendly); ``--scale
+full`` lifts trial counts/model sizes toward the BASELINE targets. Prints
+one JSON line per config plus a summary line:
+
+    {"config": "asha_resnet", "trials": 16, "wall_s": ..., "trials_per_hour":
+     ..., "best_objective": ..., "broken": 0}
+
+Usage:
+    python benchmarks/run.py [--scale smoke|full] [--only tpe_mlp ...]
+    # CPU: JAX_PLATFORMS=cpu python benchmarks/run.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+#: per-config: (yaml config or None, max_trials by scale, user command)
+CONFIGS = {
+    "random_rosenbrock": {
+        "config": None,
+        "max_trials": {"smoke": 30, "full": 200},
+        "cmd": [
+            os.path.join(EXAMPLES, "rosenbrock.py"),
+            "-x~uniform(-5, 10)", "-y~uniform(-5, 10)",
+        ],
+    },
+    "tpe_mlp": {
+        "config": os.path.join(EXAMPLES, "tpe.yaml"),
+        "max_trials": {"smoke": 12, "full": 64},
+        "cmd": [
+            os.path.join(EXAMPLES, "mlp_mnist.py"),
+            "--lr~loguniform(1e-4, 1e-1)",
+            "--width~uniform(64, 512, discrete=True)",
+            "--depth~uniform(1, 4, discrete=True)",
+            "--dropout~uniform(0.0, 0.5)",
+            "--epochs", "1",
+        ],
+    },
+    "asha_resnet": {
+        "config": os.path.join(EXAMPLES, "asha.yaml"),
+        "max_trials": {"smoke": 8, "full": 64},
+        "cmd": [
+            os.path.join(EXAMPLES, "resnet_cifar.py"),
+            "--lr~loguniform(1e-3, 1.0)",
+            "--momentum~uniform(0.8, 0.99)",
+            "--weight-decay~loguniform(1e-6, 1e-2)",
+            "--epochs~fidelity(1, 4, base=2)",
+            "--depth", "18",  # smoke: ResNet-18 stem; full uses 50
+        ],
+        "cmd_full_overrides": {"--depth": "50"},
+    },
+    "hyperband_transformer": {
+        "config": os.path.join(EXAMPLES, "hyperband.yaml"),
+        "max_trials": {"smoke": 9, "full": 27},
+        "cmd": [
+            os.path.join(EXAMPLES, "transformer_wmt.py"),
+            "--lr~loguniform(1e-4, 5e-3)",
+            "--dropout~uniform(0.0, 0.3)",
+            "--warmup~uniform(50, 400, discrete=True)",
+            "--epochs~fidelity(1, 4, base=2)",
+        ],
+    },
+    "evolution_ppo": {
+        "config": os.path.join(EXAMPLES, "evolution.yaml"),
+        "max_trials": {"smoke": 10, "full": 60},
+        "cmd": [
+            os.path.join(EXAMPLES, "ppo_atari.py"),
+            "--lr~loguniform(1e-5, 1e-2)",
+            "--clip-eps~uniform(0.05, 0.4)",
+            "--ent-coef~loguniform(1e-4, 1e-1)",
+            "--epochs~fidelity(2, 8, base=2)",
+        ],
+    },
+}
+
+
+def run_config(name: str, spec: dict, scale: str, ledger_root: str) -> dict:
+    max_trials = spec["max_trials"][scale]
+    cmd = list(spec["cmd"])
+    if scale == "full":
+        for flag, val in (spec.get("cmd_full_overrides") or {}).items():
+            i = cmd.index(flag)
+            cmd[i + 1] = val
+    argv = [
+        sys.executable, "-m", "metaopt_tpu", "hunt",
+        "-n", name,
+        "--max-trials", str(max_trials),
+        "--ledger", os.path.join(ledger_root, name),
+        "--exp-max-broken", "3",
+    ]
+    if spec["config"]:
+        argv += ["--config", spec["config"]]
+    argv += ["--"] + cmd
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.time()
+    proc = subprocess.run(argv, env=env, capture_output=True, text=True)
+    wall = time.time() - t0
+
+    out = {"config": name, "trials": max_trials, "wall_s": round(wall, 1)}
+    if proc.returncode != 0:
+        out["error"] = proc.stderr[-500:]
+        return out
+    try:
+        summary = json.loads(proc.stdout[proc.stdout.index("{"):])
+    except (ValueError, json.JSONDecodeError):
+        out["error"] = "unparseable hunt output"
+        return out
+    completed = summary["total"].get("completed", 0)
+    out.update(
+        trials=completed,
+        trials_per_hour=round(3600 * completed / wall, 1),
+        best_objective=(summary.get("best") or {}).get("objective"),
+        broken=summary["total"].get("broken", 0),
+        pruned=summary.get("pruned_by_worker", 0),
+    )
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", choices=("smoke", "full"), default="smoke")
+    p.add_argument("--only", nargs="*", choices=sorted(CONFIGS), default=None)
+    args = p.parse_args()
+
+    results = []
+    with tempfile.TemporaryDirectory(prefix="mtpu_bench_") as root:
+        for name, spec in CONFIGS.items():
+            if args.only and name not in args.only:
+                continue
+            res = run_config(name, spec, args.scale, root)
+            print(json.dumps(res), flush=True)
+            results.append(res)
+
+    ok = [r for r in results if "error" not in r]
+    print(json.dumps({
+        "summary": True,
+        "scale": args.scale,
+        "configs_ok": len(ok),
+        "configs_total": len(results),
+        "total_trials": sum(r["trials"] for r in ok),
+        "total_wall_s": round(sum(r["wall_s"] for r in results), 1),
+    }))
+    return 0 if len(ok) == len(results) else 1
+
+
+if __name__ == "__main__":
+    main()

@@ -34,10 +34,11 @@ def main():
         "lr": a.lr, "momentum": a.momentum, "weight_decay": a.weight_decay,
         "batch_size": a.batch_size, "depth": a.depth,
     }
-    err = None
-    for epoch in range(1, a.epochs + 1):
-        err = train_and_eval(hp, epochs=1, seed=epoch)
-        report_partial(err, epoch)
+    # one continuous run; each epoch streams a partial for the judge/ASHA
+    err = train_and_eval(
+        hp, epochs=a.epochs,
+        on_epoch=lambda ep, e: report_partial(e, ep),
+    )
     report_results([{"name": "val_error", "type": "objective", "value": err}])
 
 

@@ -8,7 +8,8 @@ coordinator can snapshot and observe-replay on restart.
 
 Implementations: Random, GradientDescent (exercises the gradient-result
 protocol), TPE (KDE surrogate + EI as jit/vmap JAX — the north-star hot
-path), Hyperband, ASHA, EvolutionES, plus the test-support DumbAlgo.
+path), Hyperband, ASHA, BOHB (TPE-guided Hyperband), EvolutionES,
+plus the test-support DumbAlgo.
 """
 
 from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry, make_algorithm
@@ -17,6 +18,7 @@ from metaopt_tpu.algo.gradient_descent import GradientDescent
 from metaopt_tpu.algo.tpe import TPE
 from metaopt_tpu.algo.hyperband import Hyperband
 from metaopt_tpu.algo.asha import ASHA
+from metaopt_tpu.algo.bohb import BOHB
 from metaopt_tpu.algo.evolution_es import EvolutionES
 
 __all__ = [
@@ -28,5 +30,6 @@ __all__ = [
     "TPE",
     "Hyperband",
     "ASHA",
+    "BOHB",
     "EvolutionES",
 ]

@@ -1,0 +1,109 @@
+"""BOHB — Bayesian optimization (TPE) inside Hyperband brackets.
+
+ref capability: BASELINE.json config 4 names "Hyperband/BOHB" for the
+Transformer sweep. Mechanism (Falkner et al. 2018, standard BOHB): keep
+Hyperband's bracket/budget scheduling untouched, but fill bottom rungs from
+a TPE model instead of uniform sampling. Model selection is per-budget: use
+the model of the HIGHEST budget that has enough observations (d+2 by
+default, capturing the "train on the most informative fidelity" rule); fall
+back to random sampling until any model is ready, and interleave a
+``random_fraction`` of uniform samples to keep the bandit consistent.
+
+Implementation note: the per-budget models are this framework's fused-kernel
+TPE (metaopt_tpu.algo.tpe) over the shared unit cube, so BOHB inherits the
+flat-latency suggest path on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from metaopt_tpu.algo.base import algo_registry
+from metaopt_tpu.algo.hyperband import Hyperband
+from metaopt_tpu.algo.tpe import TPE
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space import Space
+
+
+@algo_registry.register("bohb")
+class BOHB(Hyperband):
+    def __init__(
+        self,
+        space: Space,
+        seed: Optional[int] = None,
+        repetitions: Optional[int] = None,
+        reduction_factor: Optional[int] = None,
+        min_points_in_model: Optional[int] = None,
+        random_fraction: float = 1 / 3,
+        n_ei_candidates: int = 24,
+        gamma: float = 0.25,
+        **config: Any,
+    ):
+        super().__init__(
+            space,
+            seed=seed,
+            repetitions=repetitions,
+            reduction_factor=reduction_factor,
+            **config,
+        )
+        # re-record the BOHB-specific knobs in the serialized configuration
+        self._config.update(
+            min_points_in_model=min_points_in_model,
+            random_fraction=random_fraction,
+            n_ei_candidates=n_ei_candidates,
+            gamma=gamma,
+        )
+        self.random_fraction = float(random_fraction)
+        #: BOHB rule of thumb: d+2 observations before trusting a model
+        self.min_points_in_model = int(
+            min_points_in_model
+            if min_points_in_model is not None
+            else len([d for d in space.values() if d.type != "fidelity"]) + 2
+        )
+        #: one TPE per budget level, each fed only that budget's results
+        self._models: Dict[int, TPE] = {
+            b: TPE(
+                space,
+                seed=None if seed is None else seed + 17 * (i + 1),
+                n_initial_points=self.min_points_in_model,
+                n_ei_candidates=n_ei_candidates,
+                gamma=gamma,
+            )
+            for i, b in enumerate(self.budgets)
+        }
+
+    # -- observe: Hyperband bookkeeping + per-budget model updates ---------
+    def _observe_one(self, trial: Trial) -> None:
+        super()._observe_one(trial)
+        budget = int(trial.params[self.fidelity_name])
+        model = self._models.get(budget)
+        if model is not None:
+            model._observe_one(trial)
+
+    def _model_for_sampling(self) -> Optional[TPE]:
+        """The trained model of the highest budget, per the BOHB rule."""
+        for b in reversed(self.budgets):
+            m = self._models.get(b)
+            if m is not None and len(m._y) >= self.min_points_in_model:
+                return m
+        return None
+
+    # -- sampling hook: Hyperband calls this to fill bottom rungs ----------
+    def _sample_point(self) -> Dict[str, Any]:
+        model = self._model_for_sampling()
+        if model is None or self.rng.random() < self.random_fraction:
+            return self.space.sample(1, seed=self.rng)[0]
+        return model._suggest_ei(1)[0]
+
+    # -- persistence -------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        s = super().state_dict()
+        s["models"] = {str(b): m.state_dict() for b, m in self._models.items()}
+        return s
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        super().load_state_dict(state)
+        for b_str, mstate in (state.get("models") or {}).items():
+            model = self._models.get(int(b_str))
+            if model is not None:
+                model.load_state_dict(mstate)

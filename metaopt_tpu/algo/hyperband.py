@@ -148,6 +148,10 @@ class Hyperband(BaseAlgorithm):
             out.append(pt)
         return out
 
+    def _sample_point(self) -> Dict[str, Any]:
+        """Bottom-rung sampling hook; BOHB overrides with model-guided draws."""
+        return self.space.sample(1, seed=self.rng)[0]
+
     def _suggest_one(self) -> Optional[Dict[str, Any]]:
         if all(b.is_done for b in self.brackets):
             if self.repetitions is not None and self._execution >= self.repetitions:
@@ -161,7 +165,7 @@ class Hyperband(BaseAlgorithm):
             if kind == "fill":
                 rung = payload
                 for _ in range(100):
-                    pt = self.space.sample(1, seed=self.rng)[0]
+                    pt = self._sample_point()
                     pt[self.fidelity_name] = rung.budget
                     lineage = self.space.hash_point(pt)
                     key = (lineage, rung.budget)

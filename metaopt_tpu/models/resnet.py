@@ -99,8 +99,14 @@ def train_and_eval(
     epochs: int = 1,
     seed: int = 0,
     hw: int = 32,
+    on_epoch=None,
 ) -> float:
-    """Train on synthetic CIFAR-shaped data; return validation error."""
+    """Train on synthetic CIFAR-shaped data; return validation error.
+
+    ``on_epoch(epoch, val_error)`` fires after each epoch so multi-fidelity
+    scripts can stream partials (client.report_partial) from ONE continuous
+    training run — the fidelity axis continues training, it never restarts.
+    """
     lr = float(hparams.get("lr", 0.1))
     momentum = float(hparams.get("momentum", 0.9))
     weight_decay = float(hparams.get("weight_decay", 1e-4))
@@ -147,17 +153,21 @@ def train_and_eval(
         )
         return (p, bs, o), losses.mean()
 
-    carry = (params, batch_stats, opt_state)
-    for e in range(int(epochs)):
-        carry, _ = epoch(carry, jax.random.fold_in(key, 1000 + e))
-    params, batch_stats = carry[0], carry[1]
-
     @jax.jit
     def val_error(p, bs):
         logits = model.apply({"params": p, "batch_stats": bs}, xv, train=False)
         return 1.0 - jnp.mean(jnp.argmax(logits, -1) == yv)
 
-    return float(val_error(params, batch_stats))
+    carry = (params, batch_stats, opt_state)
+    err = 1.0
+    for e in range(int(epochs)):
+        carry, _ = epoch(carry, jax.random.fold_in(key, 1000 + e))
+        if on_epoch is not None:
+            err = float(val_error(carry[0], carry[1]))
+            on_epoch(e + 1, err)
+    if on_epoch is None:
+        err = float(val_error(carry[0], carry[1]))
+    return err
 
 
 def make_objective(**fixed):

@@ -10,6 +10,8 @@ import multiprocessing as mp
 import os
 import sys
 
+import pytest
+
 from metaopt_tpu.executor import InProcessExecutor
 from metaopt_tpu.ledger import Experiment
 from metaopt_tpu.ledger.backends import make_ledger
@@ -27,9 +29,6 @@ def _worker(ledger_cfg: dict, worker_id: str, out_path: str) -> None:
     )
     with open(out_path, "w") as f:
         json.dump({"completed": stats.completed, "events": stats.events}, f)
-
-
-import pytest
 
 
 @pytest.mark.parametrize("backend", ["file", "native"])

@@ -200,3 +200,61 @@ class TestEvolutionES:
         algo2.load_state_dict(algo.state_dict())
         assert algo2.generation == algo.generation
         assert algo2._survivors == algo._survivors
+
+
+class TestBOHB:
+    def test_scheduling_matches_hyperband(self):
+        """BOHB must not change bracket/budget scheduling, only sampling."""
+        from metaopt_tpu.algo import BOHB
+
+        space = make_space(fidelity=True)
+        algo = BOHB(space, seed=0, repetitions=1)
+        caps = [[r.capacity for r in b.rungs] for b in algo.brackets]
+        assert caps == [[4, 2, 1], [3, 1], [3]]
+        first = algo.suggest(4)
+        assert [p["epochs"] for p in first] == [1, 1, 1, 1]
+
+    def test_model_guides_sampling_after_min_points(self):
+        """With a trained model and random_fraction=0, fills should come
+        from TPE's good-region — concentrated near the observed optimum."""
+        from metaopt_tpu.algo import BOHB
+
+        space = build_space(
+            {"x": "uniform(0, 1)", "epochs": "fidelity(1, 4, base=2)"}
+        )
+        algo = BOHB(space, seed=3, repetitions=None, random_fraction=0.0,
+                    min_points_in_model=5)
+        # seed the budget-4 model directly: best points cluster near x=0.2
+        for i in range(12):
+            x = 0.2 + 0.02 * (i % 3) if i < 8 else 0.9
+            y = abs(x - 0.2)
+            algo._models[4]._observe_one(
+                completed({"x": x, "epochs": 4}, y, space)
+            )
+        model = algo._model_for_sampling()
+        assert model is algo._models[4]
+        pts = [algo._sample_point()["x"] for _ in range(10)]
+        near = sum(1 for x in pts if abs(x - 0.2) < 0.2)
+        assert near >= 7, f"model-guided samples not concentrated: {pts}"
+
+    def test_random_fallback_before_model_ready(self):
+        from metaopt_tpu.algo import BOHB
+
+        space = make_space(fidelity=True)
+        algo = BOHB(space, seed=0)
+        assert algo._model_for_sampling() is None
+        assert algo._sample_point() in space
+
+    def test_state_roundtrip_restores_models(self):
+        from metaopt_tpu.algo import BOHB
+
+        space = build_space(
+            {"x": "uniform(0, 1)", "epochs": "fidelity(1, 4, base=2)"}
+        )
+        a1 = BOHB(space, seed=5, min_points_in_model=3)
+        for p in a1.suggest(4):
+            a1.observe([completed(p, p["x"], space)])
+        a2 = BOHB(space, seed=5, min_points_in_model=3)
+        a2.load_state_dict(a1.state_dict())
+        assert len(a2._models[1]._y) == len(a1._models[1]._y)
+        assert a2.suggest(2) == a1.suggest(2)

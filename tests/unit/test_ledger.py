@@ -129,6 +129,19 @@ class TestTrialOps:
 
 
 class TestRegressionFixes:
+    def test_register_reserved_preserves_ownership(self, ledger):
+        """Snapshot restore registers already-reserved trials: the ownership
+        record (worker + live heartbeat) must survive, or the owner's next
+        heartbeat fails and the stale sweep double-executes the trial."""
+        t = _trial(1.0)
+        t.transition("reserved")
+        t.worker = "w9"
+        ledger.register(t)
+        assert ledger.heartbeat("exp", t.id, "w9")
+        assert ledger.release_stale("exp", timeout_s=60) == []
+        got = ledger.get("exp", t.id)
+        assert got.status == "reserved" and got.worker == "w9"
+
     def test_aba_stale_worker_cannot_clobber(self, ledger):
         """A released-then-reissued reservation must reject the old owner's write."""
         ledger.register(_trial(1.0))
