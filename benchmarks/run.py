@@ -66,9 +66,14 @@ CONFIGS = {
             "--momentum~uniform(0.8, 0.99)",
             "--weight-decay~loguniform(1e-6, 1e-2)",
             "--epochs~fidelity(1, 4, base=2)",
-            "--depth", "18",  # smoke: ResNet-18 stem; full uses 50
+            # smoke: tiny ResNet-18 (CPU-compileable); full restores BASELINE
+            "--depth", "18", "--n-train", "256", "--n-val", "128",
+            "--batch-size", "64", "--width", "16", "--hw", "16",
         ],
-        "cmd_full_overrides": {"--depth": "50"},
+        "cmd_full_overrides": {
+            "--depth": "50", "--n-train": "4096", "--n-val": "1024",
+            "--batch-size": "128", "--width": "64", "--hw": "32",
+        },
     },
     "hyperband_transformer": {
         "config": os.path.join(EXAMPLES, "hyperband.yaml"),
@@ -79,7 +84,13 @@ CONFIGS = {
             "--dropout~uniform(0.0, 0.3)",
             "--warmup~uniform(50, 400, discrete=True)",
             "--epochs~fidelity(1, 4, base=2)",
+            "--tp", "1", "--steps-per-epoch", "10",
+            "--d-model", "128", "--n-layers", "2", "--d-ff", "256",
         ],
+        "cmd_full_overrides": {
+            "--tp": "2", "--steps-per-epoch": "50",
+            "--d-model": "512", "--n-layers": "6", "--d-ff": "2048",
+        },
     },
     "evolution_ppo": {
         "config": os.path.join(EXAMPLES, "evolution.yaml"),
@@ -115,6 +126,11 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str) -> dict:
 
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if env.get("JAX_PLATFORMS") == "cpu":
+        # CPU-only smoke: don't let each spawned python dial the single-slot
+        # TPU relay (axon sitecustomize), or concurrent trials starve in its
+        # claim-retry backoff loop
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     t0 = time.time()
     proc = subprocess.run(argv, env=env, capture_output=True, text=True)
     wall = time.time() - t0
@@ -167,4 +183,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -26,17 +26,23 @@ def main():
     p.add_argument("--batch-size", dest="batch_size", type=int, default=128)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--n-train", dest="n_train", type=int, default=4096,
+                   help="synthetic train-set size (smoke runs shrink this)")
+    p.add_argument("--n-val", dest="n_val", type=int, default=1024)
+    p.add_argument("--width", type=int, default=64,
+                   help="stem width (CPU smoke runs shrink this)")
+    p.add_argument("--hw", type=int, default=32, help="image side length")
     a = p.parse_args()
 
     from metaopt_tpu.models.resnet import train_and_eval
 
     hp = {
         "lr": a.lr, "momentum": a.momentum, "weight_decay": a.weight_decay,
-        "batch_size": a.batch_size, "depth": a.depth,
+        "batch_size": a.batch_size, "depth": a.depth, "width": a.width,
     }
     # one continuous run; each epoch streams a partial for the judge/ASHA
     err = train_and_eval(
-        hp, epochs=a.epochs,
+        hp, epochs=a.epochs, n_train=a.n_train, n_val=a.n_val, hw=a.hw,
         on_epoch=lambda ep, e: report_partial(e, ep),
     )
     report_results([{"name": "val_error", "type": "objective", "value": err}])

@@ -26,12 +26,18 @@ def main():
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--tp", type=int, default=2)
     p.add_argument("--steps-per-epoch", type=int, default=50)
+    p.add_argument("--d-model", dest="d_model", type=int, default=512,
+                   help="model width (smoke runs shrink the base config)")
+    p.add_argument("--n-layers", dest="n_layers", type=int, default=6)
+    p.add_argument("--d-ff", dest="d_ff", type=int, default=2048)
     a = p.parse_args()
 
     from metaopt_tpu.models.transformer import train_and_eval
 
     loss = train_and_eval(
-        {"lr": a.lr, "dropout": a.dropout, "warmup": a.warmup},
+        {"lr": a.lr, "dropout": a.dropout, "warmup": a.warmup,
+         "d_model": a.d_model, "n_layers": a.n_layers, "d_ff": a.d_ff,
+         "n_heads": max(1, a.d_model // 64)},
         tp=a.tp,
         steps=a.epochs * a.steps_per_epoch,
     )

@@ -120,7 +120,8 @@ class Hyperband(BaseAlgorithm):
         lineage = trial.lineage or self.space.hash_point(trial.params)
         bracket = self._lineage_bracket.get((lineage, budget))
         if bracket is None:
-            # stray (replay/insert): any bracket with a matching, assigned rung
+            # stray (observe-replay after restart, manual insert): first try
+            # a bracket that already assigned this lineage at this budget
             for b in self.brackets:
                 for r in b.rungs:
                     if r.budget == budget and lineage in r.assigned:
@@ -129,7 +130,27 @@ class Hyperband(BaseAlgorithm):
                 if bracket:
                     break
         if bracket is None:
-            return
+            # absorb: adopt into the first bracket with free capacity at
+            # this budget (exact-capacity bracket as fallback), so replaying
+            # a completed ledger reconstructs usable rung state
+            fallback = None
+            for b in self.brackets:
+                for r in b.rungs:
+                    if r.budget != budget:
+                        continue
+                    if not r.is_full:
+                        bracket = b
+                        break
+                    fallback = fallback or b
+                if bracket:
+                    break
+            bracket = bracket or fallback
+            if bracket is None:
+                return
+            for r in bracket.rungs:
+                if r.budget == budget:
+                    r.assigned.add(lineage)
+            self._lineage_bracket[(lineage, budget)] = bracket
         for rung in bracket.rungs:
             if rung.budget == budget:
                 cur = rung.results.get(lineage)

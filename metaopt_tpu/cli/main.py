@@ -72,6 +72,9 @@ def build_parser() -> argparse.ArgumentParser:
     st = sub.add_parser("status", help="show experiment state")
     common(st)
     st.add_argument("--json", action="store_true", dest="as_json")
+    st.add_argument("--rungs", action="store_true",
+                    help="rung occupancy for multi-fidelity algorithms "
+                         "(replays completed trials into the algorithm)")
 
     srv = sub.add_parser(
         "serve", help="run the pod coordinator (single-writer ledger service)"
@@ -233,7 +236,14 @@ def _cmd_status(args, cfg: Dict[str, Any]) -> int:
         if doc is None:
             raise SystemExit(f"no such experiment: {name}")
         exp = Experiment(name, ledger).configure()
-        out.append(exp.stats)
+        s = exp.stats
+        if args.rungs and exp.algorithm and exp.space.fidelity is not None:
+            from metaopt_tpu.algo.base import make_algorithm
+
+            algo = make_algorithm(exp.space, exp.algorithm)
+            algo.observe(exp.fetch_completed_trials())
+            s["rungs"] = getattr(algo, "rung_table", None)
+        out.append(s)
     if args.as_json:
         print(json.dumps(out, indent=2))
     else:
@@ -243,6 +253,14 @@ def _cmd_status(args, cfg: Dict[str, Any]) -> int:
             if s["best"]:
                 print(f"  best objective {s['best']['objective']:.6g} "
                       f"at {s['best']['params']}")
+            for r in s.get("rungs") or []:
+                line = (f"  bracket {r['bracket']} budget {r['budget']:>5}: "
+                        f"{r['n'] if 'n' in r else r['completed']} completed")
+                if "capacity" in r:
+                    line += f", {r['assigned']}/{r['capacity']} assigned"
+                if "promoted" in r:
+                    line += f", {r['promoted']} promoted"
+                print(line)
     return 0
 
 

@@ -119,12 +119,13 @@ class NativeFileLedger(FileLedger):
                 h, _safe(trial.id), _safe(trial.status), payload,
                 float(trial.submit_time or 0.0),
             )
+            cas_rc = 0
             if rc == 0 and (trial.worker or trial.heartbeat):
                 # snapshot restore may register an already-reserved trial:
                 # its ownership record (worker + heartbeat) must survive into
                 # the engine or the owner's next heartbeat fails and the
                 # stale sweep double-executes the trial
-                self._lib.ls_cas(
+                cas_rc = self._lib.ls_cas(
                     h, _safe(trial.id), b"", b"", _safe(trial.status),
                     _safe(trial.worker or ""), b"",
                     float(trial.heartbeat or 0.0),
@@ -133,6 +134,10 @@ class NativeFileLedger(FileLedger):
             raise DuplicateTrialError(trial.id)
         if rc != 0:
             raise RuntimeError(f"ledgerstore put failed ({rc})")
+        if cas_rc != 0:
+            raise RuntimeError(
+                f"ledgerstore ownership record failed ({cas_rc}) for {trial.id}"
+            )
 
     def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
         h, lk = self._handle(experiment)

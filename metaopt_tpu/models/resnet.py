@@ -112,7 +112,10 @@ def train_and_eval(
     weight_decay = float(hparams.get("weight_decay", 1e-4))
     batch_size = int(hparams.get("batch_size", 128))
 
-    model = ResNet(depth=int(hparams.get("depth", depth)))
+    model = ResNet(
+        depth=int(hparams.get("depth", depth)),
+        width=int(hparams.get("width", 64)),
+    )
     key = jax.random.PRNGKey(seed)
     kd, kv, ki = jax.random.split(key, 3)
     x, y = synthetic_images(kd, n_train, hw=hw, channels=3)

@@ -33,6 +33,8 @@ class WorkerStats:
     pruned: int = 0
     idle_cycles: int = 0
     events: List[Dict[str, Any]] = field(default_factory=list)
+    #: producer timing aggregates (observe/suggest latency, SURVEY.md §5)
+    producer_timings: Dict[str, float] = field(default_factory=dict)
 
 
 def workon(
@@ -140,4 +142,5 @@ def workon(
 
     # final observe so the algorithm state is current for callers
     algo.observe(experiment.fetch_completed_trials())
+    stats.producer_timings = dict(producer.timings)
     return stats

@@ -8,7 +8,8 @@ ledger's duplicate detection absorbs suggestion races between workers).
 from __future__ import annotations
 
 import logging
-from typing import Optional
+import time
+from typing import Dict, Optional
 
 from metaopt_tpu.algo.base import BaseAlgorithm
 from metaopt_tpu.ledger.experiment import Experiment
@@ -20,11 +21,18 @@ class Producer:
     def __init__(self, experiment: Experiment, algorithm: BaseAlgorithm):
         self.experiment = experiment
         self.algorithm = algorithm
+        #: rolling timing aggregates (SURVEY.md §5: suggest-latency events)
+        self.timings: Dict[str, float] = {
+            "observe_s": 0.0, "suggest_s": 0.0, "cycles": 0, "suggested": 0,
+        }
 
     def produce(self, pool_size: Optional[int] = None) -> int:
         """One observe→suggest→register cycle; returns #trials registered."""
         exp = self.experiment
+        t0 = time.perf_counter()
         self.algorithm.observe(exp.fetch_completed_trials())
+        self.timings["observe_s"] += time.perf_counter() - t0
+        self.timings["cycles"] += 1
 
         if self.algorithm.is_done:
             exp.mark_algo_done()
@@ -38,7 +46,10 @@ class Producer:
         if want <= 0:
             return 0
 
+        t1 = time.perf_counter()
         points = self.algorithm.suggest(want)
+        self.timings["suggest_s"] += time.perf_counter() - t1
+        self.timings["suggested"] += len(points)
         if not points:
             return 0
         trials = [exp.make_trial(p) for p in points]

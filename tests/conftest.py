@@ -10,6 +10,11 @@ The env vars must be set before jax (or anything importing jax) loads.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests (and every trial subprocess they spawn) are CPU-only. Without this,
+# the axon sitecustomize in each spawned python dials the single-slot TPU
+# relay; a herd of concurrent trial processes then starves in its jittered
+# claim-retry loop (multi-second sleeps, no progress).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

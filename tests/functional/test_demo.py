@@ -192,3 +192,37 @@ class TestJudgePruning:
             # measurement is the last partial objective
             assert t.objective is not None
             assert any(r.name == "pruned_at_step" for r in t.results)
+
+
+class TestChaos:
+    def test_hunt_completes_under_injected_faults(self, tmp_path):
+        """Chaos tier (SURVEY.md §5 fault injection): spawn failures and
+        mid-run kills must surface as broken trials, never stall the loop,
+        and the experiment must still reach max_trials."""
+        from metaopt_tpu.executor import SubprocessExecutor
+        from metaopt_tpu.executor.faults import faults
+        from metaopt_tpu.space import SpaceBuilder
+        from metaopt_tpu.worker import workon
+
+        faults.reset()
+        faults.arm("spawn_fail", times=1)
+        faults.arm("kill_trial", times=2)
+        try:
+            argv = [BLACK_BOX, "-x~uniform(-5, 5)"]
+            space, template = SpaceBuilder().build(argv)
+            exp = Experiment(
+                "chaos", make_ledger({"type": "file", "path": str(tmp_path)}),
+                space=space, max_trials=6,
+                algorithm={"random": {"seed": 4}},
+            ).configure()
+            execu = SubprocessExecutor(
+                template, interpreter=[sys.executable], poll_interval_s=0.05
+            )
+            stats = workon(exp, execu, "w0", max_broken=10)
+            assert stats.broken == 3          # 1 spawn_fail + 2 kill_trial
+            assert exp.count("completed") == 6
+            assert exp.is_done
+            assert faults.fired("spawn_fail") == 1
+            assert faults.fired("kill_trial") == 2
+        finally:
+            faults.reset()

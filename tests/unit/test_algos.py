@@ -258,3 +258,28 @@ class TestBOHB:
         a2.load_state_dict(a1.state_dict())
         assert len(a2._models[1]._y) == len(a1._models[1]._y)
         assert a2.suggest(2) == a1.suggest(2)
+
+
+class TestHyperbandReplay:
+    def test_observe_replay_reconstructs_rungs(self):
+        """A fresh Hyperband fed only a completed ledger (coordinator
+        restart / status --rungs path) must reconstruct rung occupancy and
+        keep scheduling, not drop every stray observation."""
+        space = make_space(fidelity=True)
+        a1 = Hyperband(space, seed=0, repetitions=1)
+        done = []
+        while True:
+            pts = a1.suggest(4)
+            if not pts:
+                break
+            for p in pts:
+                t = completed(p, float(abs(p["x"])), space)
+                a1.observe([t])
+                done.append(t)
+        # replay into a fresh instance (no state_dict)
+        a2 = Hyperband(space, seed=0, repetitions=1)
+        a2.observe(done)
+        occ1 = [(r["budget"], r["completed"]) for r in a1.rung_table]
+        occ2 = [(r["budget"], r["completed"]) for r in a2.rung_table]
+        assert sorted(occ1) == sorted(occ2)
+        assert a2.is_done
