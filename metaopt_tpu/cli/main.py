@@ -195,12 +195,17 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
     )
     executor.close()
     s = exp.stats
+    timings = {
+        k: round(v, 4) if isinstance(v, float) else v
+        for k, v in stats.producer_timings.items()
+    }
     print(json.dumps({
         "experiment": exp.name,
         "worker": worker_id,
         "completed_by_worker": stats.completed,
         "broken_by_worker": stats.broken,
         "pruned_by_worker": stats.pruned,
+        "producer_timings": timings,
         "total": s["by_status"],
         "best": s["best"],
     }, indent=2))

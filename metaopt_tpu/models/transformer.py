@@ -46,11 +46,24 @@ class MHA(nn.Module):
         q = dense("q")(q_in) / np.sqrt(d_head)
         k = dense("k")(kv_in)
         v = dense("v")(kv_in)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        if mask is not None:
-            logits = jnp.where(mask, logits, -1e9)
-        attn = jax.nn.softmax(logits, axis=-1).astype(jnp.bfloat16)
-        out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        from metaopt_tpu.ops.attention import flash_attention, use_flash_attention
+
+        if use_flash_attention():
+            # masks here are (b, 1, q|1, k) with heads shared — flatten to
+            # the kernel's (b, q, k) convention
+            m3 = None
+            if mask is not None:
+                m3 = jnp.broadcast_to(
+                    mask[:, 0],
+                    (q.shape[0], q.shape[1], k.shape[1]),
+                )
+            out = flash_attention(q, k, v, m3)
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            if mask is not None:
+                logits = jnp.where(mask, logits, -1e9)
+            attn = jax.nn.softmax(logits, axis=-1).astype(jnp.bfloat16)
+            out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
         return nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=jnp.bfloat16, name="out",
             kernel_init=nn.with_partitioning(

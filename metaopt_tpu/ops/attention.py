@@ -1,0 +1,207 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The demo-zoo Transformer (BASELINE config 4) is the framework's flagship
+trial workload; its attention is the one genuinely hot op we own end-to-end.
+The plain XLA path materializes the (B, H, Sq, Sk) logits tensor in HBM —
+O(S²) memory traffic, the classic attention bottleneck. This kernel is the
+TPU-native fix: blocked **online-softmax** attention (Flash Attention
+forward) that keeps Q·Kᵀ tiles in VMEM, carries running (max, denominator,
+accumulator) statistics across K blocks, and never writes the quadratic
+logits to HBM. MXU does the two matmuls per tile; the VPU handles the
+rescaling.
+
+Backward uses a custom VJP that recomputes attention in plain XLA from the
+saved (q, k, v, mask) residuals — the standard recompute strategy: the
+forward's O(S²) HBM saving is kept, the backward trades FLOPs for memory.
+
+The kernel runs in Pallas interpret mode on CPU (tests exercise numerics +
+grads without TPU hardware); on the axon TPU backend it compiles to Mosaic.
+``MHA`` in metaopt_tpu.models.transformer routes here when the backend is
+TPU (env override: METAOPT_TPU_FLASH=0|1).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_BIG = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int):
+    """One (batch·head, q-block) program: online softmax over K blocks.
+
+    Shapes in VMEM: q (1, Bq, D); k/v (1, Sk, D); mask (1, Bq, Sk) bool or
+    None; o (1, Bq, D).
+    """
+    q = q_ref[0].astype(jnp.float32)                      # (Bq, D)
+    bq, d = q.shape
+    sk = k_ref.shape[1]
+    n_blocks = sk // block_k
+
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(                           # (Bq, Bk) on MXU
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if mask_ref is not None:
+            # int8 (not i1): Mosaic's sub-byte bool tiling is a pitfall
+            mb = mask_ref[0, :, pl.ds(i * block_k, block_k)]
+            s = jnp.where(mb != 0, s, _NEG_BIG)
+        # floor the running max above the mask fill: a fully-masked block
+        # would otherwise get exp(s - m) = exp(0) = 1 (uniform attention)
+        m_new = jnp.maximum(
+            jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True)), 0.5 * _NEG_BIG
+        )
+        alpha = jnp.exp(m - m_new)                         # rescale old stats
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    # fully-masked rows have l == 0; emit zeros rather than NaNs
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pick_block(size: int, target: int) -> int:
+    if size % target == 0:
+        return target
+    return size  # irregular lengths: single block (demo seqs are short)
+
+
+def _flash_forward(
+    q: jnp.ndarray,                 # (B, Sq, H, D)
+    k: jnp.ndarray,                 # (B, Sk, H, D)
+    v: jnp.ndarray,                 # (B, Sk, H, D)
+    mask: Optional[jnp.ndarray],    # (B, Sq, Sk) bool or None
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+
+    # head-major flattening: one grid row per (batch, head)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    grid = (b * h, sq // bq)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+    ]
+    operands = [qf, kf, vf]
+    if mask is not None:
+        in_specs.append(
+            # mask is per-batch (heads share it): index by bh // h
+            pl.BlockSpec((1, bq, sk), lambda bh, qi, h=h: (bh // h, qi, 0))
+        )
+        operands.append(mask.astype(jnp.int8))
+        kernel = functools.partial(_flash_fwd_kernel, block_k=bk)
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref):
+            _flash_fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, block_k=bk)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(*operands)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _reference_attention(q, k, v, mask):
+    """Plain XLA attention (f32 softmax) — backward path + fallbacks."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if mask is not None:
+        s = jnp.where(mask[:, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    # match the kernel: fully-masked rows produce zeros, not uniform garbage
+    if mask is not None:
+        any_valid = jnp.any(mask[:, None], axis=-1, keepdims=True)
+        p = jnp.where(any_valid, p, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, mask, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, mask, block_q, block_k, interpret)
+
+
+def _flash_fwd_rule(q, k, v, mask, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, mask, block_q, block_k, interpret)
+    return out, (q, k, v, mask)
+
+
+def _flash_bwd_rule(block_q, block_k, interpret, residuals, g):
+    q, k, v, mask = residuals
+    # recompute-backward: differentiate the reference formulation
+    def f(q_, k_, v_):
+        return _reference_attention(q_, k_, v_, mask)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Blocked online-softmax attention.
+
+    q: (B, Sq, H, D) — pre-scaled (multiply by 1/sqrt(D) before calling);
+    k, v: (B, Sk, H, D); mask: optional (B, Sq, Sk) bool, True = attend
+    (shared across heads). Returns (B, Sq, H, D) in q's dtype.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, mask, block_q, block_k, interpret)
+
+
+def use_flash_attention() -> bool:
+    """Route MHA through the kernel? Opt-in via METAOPT_TPU_FLASH=1.
+
+    Deliberately NOT default-on for the TPU backend: the axon tunnel's
+    remote-compile path cannot currently build Mosaic (Pallas) programs —
+    even a trivial pallas_call hangs — so silently routing every
+    Transformer trial through the kernel would wedge on that setup. On a
+    directly-attached TPU runtime, set METAOPT_TPU_FLASH=1 (the executor
+    forwards the env to trials).
+    """
+    env = os.environ.get("METAOPT_TPU_FLASH")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    return False
