@@ -15,9 +15,58 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def preflight_backend(timeout_s: float = 90.0) -> None:
+    """Fall back to CPU if the TPU backend is unreachable.
+
+    The axon relay is single-slot and can wedge (a stuck claim makes ANY
+    ``import jax`` with PALLAS_AXON_POOL_IPS set hang indefinitely). Probe
+    it in a disposable subprocess first; on failure, scrub the axon env so
+    this process measures on CPU instead of hanging the driver.
+    """
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        return
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return
+    # Popen + poll, NOT subprocess.run(timeout=...): run()'s post-timeout
+    # cleanup waits on the child, and a child wedged inside the relay claim
+    # can be unwaitable — the guard itself would hang. Kill and move on.
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()[0]"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        rc = proc.poll()
+        if rc is not None:
+            if rc == 0:
+                return
+            break
+        time.sleep(1.0)
+    else:
+        proc.kill()
+        try:  # non-blocking reap; a relay-wedged child may be unwaitable
+            proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            pass
+    print("bench preflight: TPU backend unreachable; measuring on CPU",
+          file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # the axon sitecustomize imports jax at interpreter startup, so the env
+    # var above is snapshotted too late — re-apply via the live config
+    # (safe: no backend has been initialized yet in this process)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def build_tpe(n_obs: int, seed: int = 0):
@@ -92,6 +141,7 @@ def time_fn(fn, repeats: int = 20) -> float:
 
 
 def main() -> None:
+    preflight_backend()
     import jax
 
     n_obs = 10_000

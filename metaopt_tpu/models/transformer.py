@@ -46,9 +46,20 @@ class MHA(nn.Module):
         q = dense("q")(q_in) / np.sqrt(d_head)
         k = dense("k")(kv_in)
         v = dense("v")(kv_in)
-        from metaopt_tpu.ops.attention import flash_attention, use_flash_attention
+        from metaopt_tpu.ops.attention import (
+            _reference_attention,
+            flash_attention,
+            use_flash_attention,
+        )
 
-        if use_flash_attention():
+        # the kernel has no partitioning rule yet: under a tp>1 mesh GSPMD
+        # would all-gather the head-sharded q/k/v and run it replicated,
+        # undoing the Megatron split — keep the plain path there until the
+        # shard_map wrapping lands
+        tp_mesh = jax.interpreters.pxla.thread_resources.env.physical_mesh
+        tp_active = (not tp_mesh.empty) and dict(tp_mesh.shape).get("tp", 1) > 1
+
+        if use_flash_attention() and not tp_active:
             # masks here are (b, 1, q|1, k) with heads shared — flatten to
             # the kernel's (b, q, k) convention
             m3 = None
@@ -59,11 +70,12 @@ class MHA(nn.Module):
                 )
             out = flash_attention(q, k, v, m3)
         else:
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            m3 = None
             if mask is not None:
-                logits = jnp.where(mask, logits, -1e9)
-            attn = jax.nn.softmax(logits, axis=-1).astype(jnp.bfloat16)
-            out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+                m3 = jnp.broadcast_to(
+                    mask[:, 0], (q.shape[0], q.shape[1], k.shape[1])
+                )
+            out = _reference_attention(q, k, v, m3)
         return nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=jnp.bfloat16, name="out",
             kernel_init=nn.with_partitioning(

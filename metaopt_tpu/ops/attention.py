@@ -14,10 +14,11 @@ Backward uses a custom VJP that recomputes attention in plain XLA from the
 saved (q, k, v, mask) residuals — the standard recompute strategy: the
 forward's O(S²) HBM saving is kept, the backward trades FLOPs for memory.
 
-The kernel runs in Pallas interpret mode on CPU (tests exercise numerics +
-grads without TPU hardware); on the axon TPU backend it compiles to Mosaic.
-``MHA`` in metaopt_tpu.models.transformer routes here when the backend is
-TPU (env override: METAOPT_TPU_FLASH=0|1).
+The kernel runs in Pallas interpret mode off-TPU (tests exercise numerics +
+grads without TPU hardware); on a TPU backend it compiles via Mosaic.
+``MHA`` in metaopt_tpu.models.transformer routes here ONLY when
+``METAOPT_TPU_FLASH=1`` is set (see :func:`use_flash_attention` for why the
+kernel is opt-in rather than backend-default) and no tp>1 mesh is active.
 """
 
 from __future__ import annotations

@@ -1,0 +1,95 @@
+"""Multi-process pod test: jax.distributed + coordinator discovery.
+
+The pod story end-to-end at process fidelity (SURVEY.md §2.7): two OS
+processes form a jax.distributed "pod" on CPU, process 0 hosts the
+CoordServer, the address is agreed via the pod's collective channel
+(broadcast_one_to_all), and both processes run workon against the shared
+coordinator — the TPU-native analogue of the reference's "N machines, one
+Mongo URL" (SURVEY.md §3.2).
+"""
+
+import json
+import multiprocessing as mp
+import os
+import socket
+import time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _pod_proc(rank: int, jax_port: int, out_path: str) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        f"127.0.0.1:{jax_port}", num_processes=2, process_id=rank
+    )
+    from jax.experimental import multihost_utils
+
+    from metaopt_tpu.coord.client_backend import CoordLedgerClient
+    from metaopt_tpu.coord.pod import start_pod_coordinator
+    from metaopt_tpu.executor import InProcessExecutor
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+    from metaopt_tpu.worker import workon
+
+    host, port, server = start_pod_coordinator(stale_timeout_s=60.0)
+    assert (server is not None) == (rank == 0)
+    ledger = CoordLedgerClient(host=host, port=port)
+
+    if rank == 0:
+        exp = Experiment(
+            "podrace", ledger,
+            space=build_space({"x": "uniform(-5, 5)"}),
+            max_trials=12, pool_size=3,
+            algorithm={"random": {"seed": 0}},
+        ).configure()
+    else:
+        for _ in range(100):  # wait for process 0 to create it
+            if ledger.load_experiment("podrace") is not None:
+                break
+            time.sleep(0.1)
+        exp = Experiment("podrace", ledger).configure()
+
+    stats = workon(
+        exp, InProcessExecutor(lambda p: (p["x"] - 1.0) ** 2),
+        worker_id=f"pod-w{rank}",
+    )
+    done = exp.count("completed")
+    # barrier over the pod channel: the server host must outlive the others
+    multihost_utils.sync_global_devices("podrace-done")
+    if server is not None:
+        server.stop()
+    with open(out_path, "w") as f:
+        json.dump(
+            {"rank": rank, "completed": stats.completed, "total_done": done,
+             "events": [e["trial"] for e in stats.events]},
+            f,
+        )
+
+
+def test_two_process_pod_coordinator(tmp_path):
+    jax_port = _free_port()
+    ctx = mp.get_context("spawn")
+    outs = [str(tmp_path / f"pod{i}.json") for i in range(2)]
+    procs = [
+        ctx.Process(target=_pod_proc, args=(i, jax_port, outs[i]))
+        for i in range(2)
+    ]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=180)
+        assert p.exitcode == 0, "pod process failed (see captured stderr)"
+
+    results = [json.load(open(o)) for o in outs]
+    executed = [t for r in results for t in r["events"]]
+    assert len(executed) == len(set(executed)), "a trial ran on two processes"
+    assert sum(r["completed"] for r in results) == 12
+    assert all(r["total_done"] == 12 for r in results)
