@@ -1,26 +1,36 @@
 #!/usr/bin/env python
-"""Benchmark: the BASELINE north-star hot path.
+"""Benchmark: the BASELINE north-star hot path + model-zoo step time/MFU.
 
-Measures TPE ``suggest()`` latency with 10 000 observations on an 8-dim mixed
-space — the operation BASELINE.md requires to stay flat past 10k trials — with
-the density kernel XLA-compiled on the real TPU chip, and compares against a
-faithful numpy implementation of the exact same Parzen/EI math (the
-reference's implementation substrate: pure Python/numpy, SURVEY.md §2.9).
+Measures
+
+1. TPE ``suggest()`` latency with 10 000 observations on an 8-dim mixed
+   space — the operation BASELINE.md requires to stay flat past 10k trials —
+   with the density kernel XLA-compiled on the real TPU chip, compared
+   against a faithful numpy implementation of the exact same Parzen/EI math
+   (the reference's implementation substrate: pure Python/numpy,
+   SURVEY.md §2.9).
+2. The flagship trial workloads on the same chip: Transformer-base train-step
+   time with analytic-FLOP MFU, and ResNet-50/CIFAR step time (images/s) —
+   the per-trial cost behind BASELINE.md's trials/hour north star.
+3. A Mosaic (Pallas) compile probe behind a timeout, recording whether the
+   backend can build the flash-attention kernel natively or must use the
+   chunked XLA twin.
 
 Prints ONE JSON line:
-    {"metric": "tpe_suggest_p50_ms_10k_obs", "value": <ms>, "unit": "ms",
-     "vs_baseline": <numpy_ms / jax_ms speedup>}
+    {"metric": "tpe_suggest_ms_per_point_10k_obs_pool8", "value": <ms>,
+     "unit": "ms", "vs_baseline": <numpy_ms / jax_ms speedup>, "extra": ...}
 """
 
 from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 import numpy as np
+
+from metaopt_tpu.utils.procs import run_with_deadline, tpu_backend_reachable
 
 
 def preflight_backend(timeout_s: float = 90.0) -> None:
@@ -36,27 +46,8 @@ def preflight_backend(timeout_s: float = 90.0) -> None:
         return
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return
-    # Popen + poll, NOT subprocess.run(timeout=...): run()'s post-timeout
-    # cleanup waits on the child, and a child wedged inside the relay claim
-    # can be unwaitable — the guard itself would hang. Kill and move on.
-    proc = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()[0]"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-    )
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
-        rc = proc.poll()
-        if rc is not None:
-            if rc == 0:
-                return
-            break
-        time.sleep(1.0)
-    else:
-        proc.kill()
-        try:  # non-blocking reap; a relay-wedged child may be unwaitable
-            proc.wait(timeout=2.0)
-        except subprocess.TimeoutExpired:
-            pass
+    if tpu_backend_reachable(timeout_s):
+        return
     print("bench preflight: TPU backend unreachable; measuring on CPU",
           file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -140,6 +131,173 @@ def time_fn(fn, repeats: int = 20) -> float:
     return float(np.median(times))
 
 
+#: peak dense bf16 FLOP/s per chip by device-kind substring
+_PEAK_FLOPS = [
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v4", 275e12), ("v6", 918e12),
+]
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return 0.0  # unknown device / CPU: MFU reported as 0
+
+
+def transformer_train_flops(b, s, d, layers, d_ff, vocab) -> float:
+    """Analytic FLOPs for one train step (fwd + bwd ≈ 3× fwd matmul FLOPs).
+
+    Per-token matmul FLOPs: encoder layer 8d² (qkv/out) + 4·d·d_ff (ffn)
+    + 4·S·d (scores+values); decoder layer adds a cross-attention block;
+    readout 2·d·V per target token. Embedding gathers are ignored.
+    """
+    enc = layers * (8 * d * d + 4 * d * d_ff + 4 * s * d)
+    dec = layers * (16 * d * d + 4 * d * d_ff + 8 * s * d)
+    readout = 2 * d * vocab
+    return 3.0 * b * s * (enc + dec + readout)
+
+
+def bench_transformer(on_tpu: bool) -> dict:
+    """Train-step time + MFU for the flagship model on the current backend."""
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from metaopt_tpu.models.data import synthetic_seq2seq
+    from metaopt_tpu.models.transformer import (
+        init_sharded, make_model, make_train_step,
+    )
+    from metaopt_tpu.parallel.mesh import trial_mesh, use_mesh
+    from metaopt_tpu.parallel.sharding import shard_batch
+
+    if on_tpu:  # Transformer-base (BASELINE config 4 trial workload)
+        cfg = {"d_model": 512, "n_heads": 8, "n_layers": 6, "d_ff": 2048,
+               "vocab": 32000, "dropout": 0.1}
+        batch, seq = 32, 64
+    else:  # tiny stand-in so a CPU fallback run still emits the fields
+        cfg = {"d_model": 64, "n_heads": 4, "n_layers": 2, "d_ff": 256,
+               "vocab": 1000, "dropout": 0.1}
+        batch, seq = 8, 16
+
+    model = make_model(cfg)
+    tx = optax.adamw(1e-3)
+    mesh = trial_mesh(tp=1)
+    key = jax.random.PRNGKey(0)
+    with use_mesh(mesh):
+        params, opt_state, shardings = init_sharded(
+            model, mesh, tx, (batch, seq)
+        )
+        step = jax.jit(
+            make_train_step(model, tx),
+            in_shardings=(shardings[0], shardings[1],
+                          NamedSharding(mesh, P("dp")), None),
+            out_shardings=(shardings[0], shardings[1], None),
+            donate_argnums=(0, 1),
+        )
+        src, tgt = synthetic_seq2seq(key, batch, seq, model.vocab)
+        sharded = shard_batch(mesh, (src, tgt))
+        # warm-up/compile
+        params, opt_state, loss = step(params, opt_state, sharded, key)
+        jax.block_until_ready(loss)
+        n_steps = 20 if on_tpu else 5
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            params, opt_state, loss = step(
+                params, opt_state, sharded, jax.random.fold_in(key, i)
+            )
+        jax.block_until_ready(loss)
+        dt_ms = (time.perf_counter() - t0) * 1000 / n_steps
+
+    flops = transformer_train_flops(
+        batch, seq, cfg["d_model"], cfg["n_layers"], cfg["d_ff"], cfg["vocab"]
+    )
+    # the step runs data-parallel over the whole mesh: peak scales with it
+    peak = peak_flops(jax.devices()[0]) * mesh.size
+    mfu = (flops / (dt_ms / 1000)) / peak if peak else 0.0
+    return {
+        "transformer_step_ms": round(dt_ms, 3),
+        "transformer_tokens_per_s": round(batch * seq / (dt_ms / 1000)),
+        "mfu": round(mfu, 4),
+        "transformer_config": {**cfg, "batch": batch, "seq": seq},
+    }
+
+
+def bench_resnet(on_tpu: bool) -> dict:
+    """ResNet-50/CIFAR train-step time (BASELINE config 3 trial workload)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from metaopt_tpu.models.data import synthetic_images
+    from metaopt_tpu.models.resnet import ResNet
+
+    depth, batch = (50, 256) if on_tpu else (18, 32)
+    model = ResNet(depth=depth)
+    key = jax.random.PRNGKey(0)
+    x, y = synthetic_images(key, batch, hw=32, channels=3)
+    variables = model.init(jax.random.PRNGKey(1), x[:1], train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, bs):
+        logits, new_state = model.apply(
+            {"params": p, "batch_stats": bs}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return loss, new_state["batch_stats"]
+
+    @jax.jit
+    def step(p, bs, o):
+        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, bs)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), bs, o, loss
+
+    params, batch_stats, opt_state, loss = step(params, batch_stats, opt_state)
+    jax.block_until_ready(loss)
+    n_steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state
+        )
+    jax.block_until_ready(loss)
+    dt_ms = (time.perf_counter() - t0) * 1000 / n_steps
+    return {
+        f"resnet{depth}_step_ms": round(dt_ms, 3),
+        f"resnet{depth}_images_per_s": round(batch / (dt_ms / 1000)),
+    }
+
+
+def probe_mosaic(timeout_s: float = 90.0) -> str:
+    """Can this backend compile a Pallas (Mosaic) program? child + timeout.
+
+    The axon relay historically hangs compiling any Mosaic program — probing
+    in a disposable child turns "would wedge forever" into a recorded
+    "timeout", and a future fixed relay flips this to "ok" so the Pallas
+    flash path can be enabled on real TPU runs.
+    """
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "def k(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...] * 2\n"
+        "x = jnp.ones((8, 128), jnp.float32)\n"
+        "y = pl.pallas_call("
+        "k, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)\n"
+        "assert float(y[0, 0]) == 2.0\n"
+    )
+    rc, _ = run_with_deadline(
+        [sys.executable, "-c", code], timeout_s=timeout_s, poll_s=1.0
+    )
+    if rc is None:
+        return "timeout"
+    return "ok" if rc == 0 else "error"
+
+
 def main() -> None:
     preflight_backend()
     import jax
@@ -163,6 +321,16 @@ def main() -> None:
     tpe1k.suggest(pool)
     jax_1k_ms = time_fn(lambda: tpe1k.suggest(pool), repeats=20) / pool
 
+    on_tpu = jax.default_backend() == "tpu"
+    model_stats = {}
+    for name, fn in (("transformer", bench_transformer),
+                     ("resnet", bench_resnet)):
+        try:
+            model_stats.update(fn(on_tpu))
+        except Exception as e:  # a model bench must not sink the TPE metric
+            model_stats[f"{name}_bench_error"] = f"{type(e).__name__}: {e}"
+    mosaic = probe_mosaic() if on_tpu else "skipped-cpu"
+
     result = {
         "metric": "tpe_suggest_ms_per_point_10k_obs_pool8",
         "value": round(jax_ms, 3),
@@ -175,6 +343,8 @@ def main() -> None:
             "flatness_10k_over_1k": round(jax_ms / max(jax_1k_ms, 1e-9), 2),
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0]),
+            "mosaic_compile_probe": mosaic,
+            **model_stats,
         },
     }
     print(json.dumps(result))

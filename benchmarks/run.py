@@ -19,7 +19,14 @@ one JSON line per config plus a summary line:
 
 Usage:
     python benchmarks/run.py [--scale smoke|full] [--only tpe_mlp ...]
+                             [--backend auto|cpu|tpu] [--save]
     # CPU: JAX_PLATFORMS=cpu python benchmarks/run.py
+
+``--backend tpu`` (or auto with a reachable relay) runs the model configs on
+the real chip — trials are sequential per worker, so the single-slot axon
+relay is claimed by one trial process at a time. Rosenbrock's objective is
+pure CPU and always runs with the relay scrubbed. ``--save`` appends the
+per-config lines to benchmarks/results/{scale}_{backend}_{date}.jsonl.
 """
 
 from __future__ import annotations
@@ -33,12 +40,17 @@ import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from metaopt_tpu.utils.procs import tpu_backend_reachable  # noqa: E402
 EXAMPLES = os.path.join(REPO, "examples")
 
 #: per-config: (yaml config or None, max_trials by scale, user command)
 CONFIGS = {
     "random_rosenbrock": {
         "config": None,
+        "cpu_objective": True,  # no tensors: never worth a relay claim
         "max_trials": {"smoke": 30, "full": 200},
         "cmd": [
             os.path.join(EXAMPLES, "rosenbrock.py"),
@@ -106,7 +118,41 @@ CONFIGS = {
 }
 
 
-def run_config(name: str, spec: dict, scale: str, ledger_root: str) -> dict:
+preflight_tpu = tpu_backend_reachable
+
+
+def _kill_by_env_marker(marker: str) -> int:
+    """SIGKILL every process whose environment carries ``marker``.
+
+    Trials are ``start_new_session``'d by the executor, so neither killing
+    the hunt nor its process group reaches them — but they all inherit the
+    hunt's env. Sweeping /proc by marker reaps the whole tree, freeing the
+    single-slot relay for the next config.
+    """
+    import signal as _signal
+
+    me = os.getpid()
+    killed = 0
+    try:
+        pids = os.listdir("/proc")
+    except OSError:  # non-Linux host: nothing to sweep, don't sink the run
+        return 0
+    for pid_s in pids:
+        if not pid_s.isdigit() or int(pid_s) == me:
+            continue
+        try:
+            with open(f"/proc/{pid_s}/environ", "rb") as f:
+                if marker.encode() not in f.read():
+                    continue
+            os.kill(int(pid_s), _signal.SIGKILL)
+            killed += 1
+        except (OSError, PermissionError):
+            continue
+    return killed
+
+
+def run_config(name: str, spec: dict, scale: str, ledger_root: str,
+               backend: str, config_timeout_s: float) -> dict:
     max_trials = spec["max_trials"][scale]
     cmd = list(spec["cmd"])
     if scale == "full":
@@ -119,6 +165,7 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str) -> dict:
         "--max-trials", str(max_trials),
         "--ledger", os.path.join(ledger_root, name),
         "--exp-max-broken", "3",
+        "--timeout-s", "900",  # a wedged trial must not sink the sweep
     ]
     if spec["config"]:
         argv += ["--config", spec["config"]]
@@ -126,21 +173,47 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str) -> dict:
 
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    if env.get("JAX_PLATFORMS") == "cpu":
-        # CPU-only smoke: don't let each spawned python dial the single-slot
-        # TPU relay (axon sitecustomize), or concurrent trials starve in its
+    on_cpu = backend == "cpu" or spec.get("cpu_objective")
+    if on_cpu:
+        # don't let each spawned python dial the single-slot TPU relay
+        # (axon sitecustomize), or concurrent trials starve in its
         # claim-retry backoff loop
+        env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
+    marker = f"MTPU_BENCH_MARKER={name}-{os.getpid()}-{int(time.time())}"
+    env["MTPU_BENCH_MARKER"] = marker.split("=", 1)[1]
     t0 = time.time()
-    proc = subprocess.run(argv, env=env, capture_output=True, text=True)
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=config_timeout_s)
+    except subprocess.TimeoutExpired:
+        # the hunt's trials live in their own sessions (executor uses
+        # start_new_session), so no single kill/killpg reaches them; sweep
+        # every process carrying this config's env marker instead — an
+        # orphaned trial would keep the single-slot relay claimed and
+        # wedge every subsequent config
+        proc.kill()
+        _kill_by_env_marker(marker)
+        try:
+            stdout, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            stdout, stderr = "", "unreapable after kill"
+        return {"config": name, "trials": max_trials,
+                "wall_s": round(time.time() - t0, 1),
+                "backend": "cpu" if on_cpu else backend,
+                "error": f"config timeout ({config_timeout_s:.0f}s); "
+                         f"stderr tail: {stderr[-300:]}"}
     wall = time.time() - t0
 
-    out = {"config": name, "trials": max_trials, "wall_s": round(wall, 1)}
+    out = {"config": name, "trials": max_trials, "wall_s": round(wall, 1),
+           "backend": "cpu" if on_cpu else backend}
     if proc.returncode != 0:
-        out["error"] = proc.stderr[-500:]
+        out["error"] = stderr[-500:]
         return out
     try:
-        summary = json.loads(proc.stdout[proc.stdout.index("{"):])
+        summary = json.loads(stdout[stdout.index("{"):])
     except (ValueError, json.JSONDecodeError):
         out["error"] = "unparseable hunt output"
         return out
@@ -159,26 +232,51 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--scale", choices=("smoke", "full"), default="smoke")
     p.add_argument("--only", nargs="*", choices=sorted(CONFIGS), default=None)
+    p.add_argument("--backend", choices=("auto", "cpu", "tpu"), default="auto")
+    p.add_argument("--save", action="store_true",
+                   help="append results to benchmarks/results/")
+    p.add_argument("--config-timeout-s", type=float, default=None,
+                   help="wall cap per config (default: 1800 smoke, 7200 full)")
     args = p.parse_args()
+
+    backend = args.backend
+    if backend == "auto":
+        backend = "tpu" if preflight_tpu() else "cpu"
+    elif backend == "tpu" and not preflight_tpu():
+        print(json.dumps({"warning": "TPU backend unreachable; using CPU"}),
+              flush=True)
+        backend = "cpu"
+    cap = args.config_timeout_s or (1800.0 if args.scale == "smoke" else 7200.0)
 
     results = []
     with tempfile.TemporaryDirectory(prefix="mtpu_bench_") as root:
         for name, spec in CONFIGS.items():
             if args.only and name not in args.only:
                 continue
-            res = run_config(name, spec, args.scale, root)
+            res = run_config(name, spec, args.scale, root, backend, cap)
             print(json.dumps(res), flush=True)
             results.append(res)
 
     ok = [r for r in results if "error" not in r]
-    print(json.dumps({
+    summary = {
         "summary": True,
         "scale": args.scale,
+        "backend": backend,
         "configs_ok": len(ok),
         "configs_total": len(results),
         "total_trials": sum(r["trials"] for r in ok),
         "total_wall_s": round(sum(r["wall_s"] for r in results), 1),
-    }))
+    }
+    print(json.dumps(summary))
+    if args.save:
+        stamp = time.strftime("%Y-%m-%d")
+        path = os.path.join(
+            REPO, "benchmarks", "results",
+            f"{args.scale}_{backend}_{stamp}.jsonl",
+        )
+        with open(path, "a") as f:
+            for r in results + [summary]:
+                f.write(json.dumps(r) + "\n")
     return 0 if len(ok) == len(results) else 1
 
 

@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                       help="TPU chips per trial (enables the TPU executor)")
     hunt.add_argument("--timeout-s", type=float, default=None,
                       help="per-trial wall-clock timeout")
+    hunt.add_argument("--producer", default=None, choices=["local", "coord"],
+                      help="where suggestion runs: 'local' fits the algorithm "
+                           "in this worker; 'coord' delegates to the "
+                           "coordinator's single hosted instance "
+                           "(coord:// ledger only)")
     hunt.add_argument("--profile-dir", default=None,
                       help="capture per-trial jax.profiler traces here "
                            "(scripts opt in with `with client.profiled():`)")
@@ -192,6 +197,7 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
         ),
         max_broken=args.exp_max_broken if args.exp_max_broken is not None else 10,
         heartbeat_timeout_s=cfg.get("heartbeat_s", 30.0) * 2,
+        producer_mode=args.producer or cfg.get("producer") or "local",
     )
     executor.close()
     s = exp.stats

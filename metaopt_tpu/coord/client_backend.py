@@ -21,10 +21,11 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional
 
-from metaopt_tpu.coord.protocol import recv_msg, send_msg
+from metaopt_tpu.coord.protocol import ProtocolError, recv_msg, send_msg
 from metaopt_tpu.ledger.backends import (
     DuplicateExperimentError,
     DuplicateTrialError,
@@ -52,6 +53,7 @@ class CoordLedgerClient(LedgerBackend):
         host: Optional[str] = None,
         port: Optional[int] = None,
         connect_timeout_s: float = 10.0,
+        reconnect_window_s: Optional[float] = None,
         **_: Any,
     ) -> None:
         self.host = host or os.environ.get("METAOPT_TPU_COORD_HOST", "127.0.0.1")
@@ -59,6 +61,15 @@ class CoordLedgerClient(LedgerBackend):
         if not self.port:
             raise ValueError("coord backend needs a port (coord://host:port)")
         self.connect_timeout_s = connect_timeout_s
+        #: how long a call keeps retrying through coordinator downtime (a
+        #: restart/preemption-reschedule window). 0 = legacy one-retry. The
+        #: request id is reused across every retry, so the reply cache still
+        #: gives exactly-once for drops within one server incarnation.
+        if reconnect_window_s is None:
+            reconnect_window_s = float(
+                os.environ.get("METAOPT_TPU_COORD_RETRY_S", "0") or 0
+            )
+        self.reconnect_window_s = float(reconnect_window_s)
         self._local = threading.local()
 
     # -- connection management --------------------------------------------
@@ -88,7 +99,9 @@ class CoordLedgerClient(LedgerBackend):
         # one id per logical call, shared by the retry: the server dedups on
         # it, so "executed but reply lost" cannot double-execute the op
         msg = {"op": op, "args": args, "req": uuid.uuid4().hex}
-        for attempt in (0, 1):
+        deadline = time.monotonic() + self.reconnect_window_s
+        attempt = 0
+        while True:
             try:
                 s = self._sock()
                 send_msg(s, msg)
@@ -96,10 +109,14 @@ class CoordLedgerClient(LedgerBackend):
                 if reply is None:
                     raise ConnectionError("coordinator closed the connection")
                 break
-            except (ConnectionError, BrokenPipeError, OSError):
+            except (ConnectionError, BrokenPipeError, OSError,
+                    ProtocolError):  # incl. a reply frame cut by shutdown
                 self._drop_sock()
-                if attempt:
-                    raise
+                attempt += 1
+                if attempt >= 2:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.25)  # coordinator down; wait out the restart
         if reply["ok"]:
             return reply["result"]
         exc = _ERRORS.get(reply["error"], CoordRPCError)
@@ -167,6 +184,28 @@ class CoordLedgerClient(LedgerBackend):
             "release_stale", experiment=experiment, timeout_s=timeout_s
         )
         return [Trial.from_dict(d) for d in docs]
+
+    # -- hosted suggestion (north star: one fitted surrogate, on the
+    # coordinator, for every worker) ---------------------------------------
+    def produce(
+        self,
+        experiment: str,
+        pool_size: Optional[int] = None,
+        worker: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """One observe→suggest→register cycle on the coordinator's single
+        hosted algorithm instance; returns {"registered": n, "algo_done"}."""
+        return self._call(
+            "produce", experiment=experiment, pool_size=pool_size, worker=worker
+        )
+
+    def judge(
+        self, experiment: str, trial: Trial, partial: List[Dict[str, Any]]
+    ) -> Optional[Dict[str, Any]]:
+        """Per-trial early-stop decision from the hosted algorithm."""
+        return self._call(
+            "judge", experiment=experiment, trial=trial.to_dict(), partial=partial
+        )
 
     # -- control plane -----------------------------------------------------
     def set_signal(self, experiment: str, trial_id: str, signal: str) -> None:

@@ -20,6 +20,13 @@ reference either lacked (v0-era warts, SURVEY.md §5) or delegated to Mongo:
   lost reservation and tears the trial down. This is the pod-global
   early-stop broadcast path (coordinator channel in lieu of ICI collectives
   for control-plane traffic, SURVEY.md §2.7).
+- **Hosted suggestion** (the BASELINE north star's "KDE fit on a
+  coordinator chip"): the ``produce`` op runs one observe→suggest→register
+  cycle against a SINGLE algorithm instance the coordinator owns per
+  experiment, so N workers share one fitted surrogate instead of re-fitting
+  N divergent copies; ``judge`` forwards per-trial early-stop decisions to
+  the same instance. Reconstructed by observe-replay after a restart —
+  hosted-algorithm state needs no extra persistence beyond the ledger.
 """
 
 from __future__ import annotations
@@ -40,6 +47,31 @@ from metaopt_tpu.ledger.trial import Trial
 log = logging.getLogger(__name__)
 
 
+class _LockedLedger:
+    """Proxy that takes the server's global lock around each ledger op.
+
+    Lets the hosted Producer run its expensive algorithm fit OUTSIDE the
+    global lock while every individual ledger access still serializes with
+    the RPC dispatch path — preserving the single-writer guarantee without
+    holding the control plane hostage to a KDE fit.
+    """
+
+    def __init__(self, inner: LedgerBackend, lock: threading.RLock) -> None:
+        self._inner = inner
+        self._lock = lock
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+
+        def locked(*args: Any, **kwargs: Any) -> Any:
+            with self._lock:
+                return attr(*args, **kwargs)
+
+        return locked
+
+
 class CoordServer:
     """Serve a ledger backend over TCP; one thread per client connection.
 
@@ -57,6 +89,7 @@ class CoordServer:
         stale_timeout_s: Optional[float] = None,
         sweep_interval_s: float = 5.0,
         event_log_path: Optional[str] = None,
+        host_algorithms: bool = True,
     ) -> None:
         self.inner = inner if inner is not None else MemoryLedger()
         self._bind = (host, port)
@@ -70,6 +103,7 @@ class CoordServer:
         self._snap_lock = threading.Lock()  # serializes snapshot file writes
         self._signals: Dict[Tuple[str, str], str] = {}  # (exp, trial_id) → signal
         self._sock: Optional[socket.socket] = None
+        self._conns: set = set()  # live client connections (for stop())
         self._threads: list[threading.Thread] = []
         self._stopping = threading.Event()
         self._ops = 0
@@ -78,6 +112,16 @@ class CoordServer:
         #: them (exactly-once semantics for reserve & co.)
         self._replies: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
         self._replies_cap = 4096
+        self.host_algorithms = host_algorithms
+        #: experiment → (Producer, per-experiment lock). One algorithm
+        #: instance shared by every worker that delegates suggestion here;
+        #: the per-experiment lock serializes produce/judge on it WITHOUT
+        #: holding the global ledger lock across an algorithm fit (which
+        #: would stall heartbeats long enough for the stale sweep to
+        #: reclaim live reservations) — the Producer's ledger ops re-enter
+        #: ``_lock`` individually via :class:`_LockedLedger`.
+        self._producers: Dict[str, Any] = {}
+        self._producers_guard = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -99,14 +143,32 @@ class CoordServer:
         return self
 
     def stop(self) -> None:
+        """Orderly shutdown: stop serving FIRST, snapshot LAST.
+
+        Ordering is a durability invariant: once the final snapshot is
+        taken, no further write may be acknowledged — a client whose write
+        landed after the snapshot but got an ok reply would see that write
+        silently vanish on restore. Closing the listen socket and every
+        live connection before snapshotting forces in-flight clients onto
+        their reconnect/retry path, where the successor server answers.
+        """
         self._stopping.set()
-        if self.snapshot_path:
-            self.snapshot(self.snapshot_path)
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self.snapshot_path:
+            self.snapshot(self.snapshot_path)
         for t in self._threads:
             t.join(timeout=2)
 
@@ -222,20 +284,23 @@ class CoordServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._conns.add(conn)
         try:
-            while True:
+            while not self._stopping.is_set():
                 try:
                     msg = recv_msg(conn)
-                except (ProtocolError, ConnectionError, json.JSONDecodeError):
+                except (ProtocolError, ConnectionError, OSError,
+                        json.JSONDecodeError):
                     return
-                if msg is None:
-                    return
+                if msg is None or self._stopping.is_set():
+                    return  # drop, don't ack: stop() snapshots after this
                 reply = self._handle(msg)
                 try:
                     send_msg(conn, reply)
-                except (ConnectionError, BrokenPipeError):
+                except (ConnectionError, BrokenPipeError, OSError):
                     return
         finally:
+            self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -249,6 +314,32 @@ class CoordServer:
          "update_trial", "release_stale", "set_signal"}
     )
 
+    def _hosted_producer(self, name: str):
+        """The coordinator-owned (Producer, lock) for an experiment (lazy).
+
+        After a restart this rebuilds from scratch: the Experiment adopts
+        the (restored) ledger doc and the algorithm re-learns everything on
+        its first ``observe`` over the completed trials — the
+        observe-replay resume doctrine (SURVEY.md §5 checkpoint/resume).
+        """
+        if not self.host_algorithms:
+            raise ValueError("coordinator does not host algorithms")
+        with self._producers_guard:
+            entry = self._producers.get(name)
+            if entry is None:
+                from metaopt_tpu.algo.base import make_algorithm
+                from metaopt_tpu.ledger.experiment import Experiment
+                from metaopt_tpu.worker.producer import Producer
+
+                ledger = _LockedLedger(self.inner, self._lock)
+                if ledger.load_experiment(name) is None:
+                    raise KeyError(f"experiment {name!r} not found")
+                exp = Experiment(name, ledger=ledger).configure()
+                algo = make_algorithm(exp.space, exp.algorithm)
+                entry = (Producer(exp, algo), threading.Lock())
+                self._producers[name] = entry
+        return entry
+
     def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         """Reply-cache lookup + dispatch + store under ONE lock hold.
 
@@ -260,6 +351,37 @@ class CoordServer:
         sweep.)
         """
         op = msg.get("op")
+        if op in ("produce", "judge"):
+            # dispatched OUTSIDE _lock: an algorithm fit (TPE at 10k
+            # observations takes seconds) must not stall heartbeats — a
+            # blocked heartbeat path lets the stale sweep reclaim LIVE
+            # reservations. The per-experiment lock serializes the shared
+            # algorithm; its ledger ops re-enter _lock one at a time via
+            # _LockedLedger. Not reply-cached: a retried produce just
+            # registers extra suggestions, absorbed by the budget check +
+            # ledger dedup exactly like decentralized producer races.
+            try:
+                a = msg.get("args") or {}
+                producer, plock = self._hosted_producer(a["experiment"])
+                with plock:
+                    if op == "produce":
+                        n = producer.produce(a.get("pool_size"))
+                        if n:
+                            self._event(
+                                "produce", a["experiment"], registered=n,
+                                worker=a.get("worker"),
+                            )
+                        result: Any = {
+                            "registered": n,
+                            "algo_done": bool(producer.algorithm.is_done),
+                        }
+                    else:
+                        result = producer.algorithm.judge(
+                            Trial.from_dict(a["trial"]), a["partial"]
+                        )
+                return {"ok": True, "result": result}
+            except Exception as e:
+                return {"ok": False, "error": type(e).__name__, "msg": str(e)}
         if op == "snapshot":
             # dispatched OUTSIDE _lock: snapshot() takes _snap_lock → _lock
             # itself, and taking _lock first here would deadlock AB-BA

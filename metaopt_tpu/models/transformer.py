@@ -33,9 +33,10 @@ from metaopt_tpu.parallel.sharding import shard_batch
 class MHA(nn.Module):
     d_model: int
     n_heads: int
+    dropout: float = 0.0  # attention-weight dropout (Transformer-base: 0.1)
 
     @nn.compact
-    def __call__(self, q_in, kv_in, mask=None):
+    def __call__(self, q_in, kv_in, mask=None, *, train: bool = False):
         d_head = self.d_model // self.n_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (self.n_heads, d_head), axis=-1, dtype=jnp.bfloat16, name=name,
@@ -48,34 +49,41 @@ class MHA(nn.Module):
         v = dense("v")(kv_in)
         from metaopt_tpu.ops.attention import (
             _reference_attention,
+            attention_impl,
             flash_attention,
-            use_flash_attention,
+            sharded_flash_attention,
         )
+        from metaopt_tpu.parallel.mesh import active_mesh
 
-        # the kernel has no partitioning rule yet: under a tp>1 mesh GSPMD
-        # would all-gather the head-sharded q/k/v and run it replicated,
-        # undoing the Megatron split — keep the plain path there until the
-        # shard_map wrapping lands
-        tp_mesh = jax.interpreters.pxla.thread_resources.env.physical_mesh
-        tp_active = (not tp_mesh.empty) and dict(tp_mesh.shape).get("tp", 1) > 1
+        # masks here are (b, 1, q|1, k) with heads shared — flatten to the
+        # kernel's (b, q, k) convention
+        m3 = None
+        if mask is not None:
+            m3 = jnp.broadcast_to(
+                mask[:, 0], (q.shape[0], q.shape[1], k.shape[1])
+            )
+        rate = self.dropout if train else 0.0
+        key = self.make_rng("dropout") if rate > 0.0 else None
 
-        if use_flash_attention() and not tp_active:
-            # masks here are (b, 1, q|1, k) with heads shared — flatten to
-            # the kernel's (b, q, k) convention
-            m3 = None
-            if mask is not None:
-                m3 = jnp.broadcast_to(
-                    mask[:, 0],
-                    (q.shape[0], q.shape[1], k.shape[1]),
-                )
-            out = flash_attention(q, k, v, m3)
+        impl = attention_impl()
+        if impl == "pallas" and rate > 0.0:
+            impl = "chunked"  # the Pallas forward carries no dropout RNG
+        if impl is None:
+            out = _reference_attention(q, k, v, m3, rate, key)
         else:
-            m3 = None
-            if mask is not None:
-                m3 = jnp.broadcast_to(
-                    mask[:, 0], (q.shape[0], q.shape[1], k.shape[1])
+            mesh = active_mesh()
+            if mesh is not None and getattr(mesh, "size", 1) > 1:
+                # batch on dp, heads on tp: keeps the Megatron head split
+                # local to each shard instead of GSPMD all-gathering q/k/v
+                out = sharded_flash_attention(
+                    mesh, q, k, v, m3,
+                    dropout_rate=rate, dropout_key=key, impl=impl,
                 )
-            out = _reference_attention(q, k, v, m3)
+            else:
+                out = flash_attention(
+                    q, k, v, m3,
+                    dropout_rate=rate, dropout_key=key, impl=impl,
+                )
         return nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=jnp.bfloat16, name="out",
             kernel_init=nn.with_partitioning(
@@ -118,7 +126,8 @@ class EncoderLayer(nn.Module):
     def __call__(self, x, pad_mask, *, train: bool):
         ln = lambda n: nn.LayerNorm(dtype=jnp.float32, name=n)  # noqa: E731
         y = ln("ln1")(x)
-        x = x + MHA(self.d_model, self.n_heads, name="self_attn")(y, y, pad_mask)
+        x = x + MHA(self.d_model, self.n_heads, self.dropout,
+                    name="self_attn")(y, y, pad_mask, train=train)
         y = ln("ln2")(x)
         x = x + FeedForward(self.d_model, self.d_ff, self.dropout, name="mlp")(
             y, train=train
@@ -136,9 +145,11 @@ class DecoderLayer(nn.Module):
     def __call__(self, x, enc, causal_mask, cross_mask, *, train: bool):
         ln = lambda n: nn.LayerNorm(dtype=jnp.float32, name=n)  # noqa: E731
         y = ln("ln1")(x)
-        x = x + MHA(self.d_model, self.n_heads, name="self_attn")(y, y, causal_mask)
+        x = x + MHA(self.d_model, self.n_heads, self.dropout,
+                    name="self_attn")(y, y, causal_mask, train=train)
         y = ln("ln2")(x)
-        x = x + MHA(self.d_model, self.n_heads, name="cross_attn")(y, enc, cross_mask)
+        x = x + MHA(self.d_model, self.n_heads, self.dropout,
+                    name="cross_attn")(y, enc, cross_mask, train=train)
         y = ln("ln3")(x)
         x = x + FeedForward(self.d_model, self.d_ff, self.dropout, name="mlp")(
             y, train=train
@@ -276,7 +287,7 @@ def train_and_eval(
     seed: int = 0,
 ) -> float:
     """Train on the synthetic translation task; return final masked loss."""
-    from metaopt_tpu.parallel.mesh import trial_mesh
+    from metaopt_tpu.parallel.mesh import trial_mesh, use_mesh
 
     mesh = mesh or trial_mesh(tp=tp)
     model = make_model(hparams)
@@ -289,7 +300,7 @@ def train_and_eval(
     kd, kstep = jax.random.split(key)
     src, tgt = synthetic_seq2seq(kd, n_train, seq_len, model.vocab)
 
-    with mesh:
+    with use_mesh(mesh):
         params, opt_state, shardings = init_sharded(
             model, mesh, tx, (batch_size, seq_len), seed
         )

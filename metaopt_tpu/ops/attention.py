@@ -1,24 +1,41 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention for the demo-zoo Transformer: Pallas + chunked-XLA twins.
 
 The demo-zoo Transformer (BASELINE config 4) is the framework's flagship
 trial workload; its attention is the one genuinely hot op we own end-to-end.
 The plain XLA path materializes the (B, H, Sq, Sk) logits tensor in HBM —
-O(S²) memory traffic, the classic attention bottleneck. This kernel is the
-TPU-native fix: blocked **online-softmax** attention (Flash Attention
-forward) that keeps Q·Kᵀ tiles in VMEM, carries running (max, denominator,
-accumulator) statistics across K blocks, and never writes the quadratic
-logits to HBM. MXU does the two matmuls per tile; the VPU handles the
-rescaling.
+O(S²) memory traffic, the classic attention bottleneck. Two memory-efficient
+implementations share one custom-VJP wrapper:
 
-Backward uses a custom VJP that recomputes attention in plain XLA from the
-saved (q, k, v, mask) residuals — the standard recompute strategy: the
-forward's O(S²) HBM saving is kept, the backward trades FLOPs for memory.
+- ``impl="pallas"`` — a Pallas TPU kernel: blocked **online-softmax**
+  attention that keeps Q·Kᵀ tiles in VMEM, carries running (max,
+  denominator, accumulator) statistics across K blocks, and never writes the
+  quadratic logits to HBM. MXU does the two matmuls per tile; the VPU
+  handles the rescaling. Runs in interpret mode off-TPU; compiles via
+  Mosaic on a directly-attached TPU runtime.
+- ``impl="chunked"`` — the same blocked online-softmax as a ``lax.scan``
+  over K blocks in plain XLA. Live tiles are O(Sq·block_k), never
+  O(Sq·Sk). This twin compiles on ANY backend — including TPU runtimes
+  whose Mosaic path is unavailable (the axon relay) — and supports
+  attention-probability dropout, reproduced bit-exactly in the backward
+  from the same ``fold_in`` counter stream.
 
-The kernel runs in Pallas interpret mode off-TPU (tests exercise numerics +
-grads without TPU hardware); on a TPU backend it compiles via Mosaic.
-``MHA`` in metaopt_tpu.models.transformer routes here ONLY when
-``METAOPT_TPU_FLASH=1`` is set (see :func:`use_flash_attention` for why the
-kernel is opt-in rather than backend-default) and no tp>1 mesh is active.
+Backward is always the chunked formulation (blockwise recompute from the
+saved (q, k, v, mask, lse) — the forward emits the per-row logsumexp for
+exactly this): peak memory stays O(Sq·block_k) per step, so the forward's
+HBM saving is preserved through training rather than forfeited to a
+whole-array recompute.
+
+Irregular sequence lengths are padded up to block multiples with masked
+tails (``_block_and_pad``); block sizes never exceed the requested
+block_q/block_k.
+
+``MHA`` in metaopt_tpu.models.transformer routes here when
+``METAOPT_TPU_FLASH`` selects an implementation (see :func:`attention_impl`
+for why the Pallas kernel is opt-in rather than backend-default), and wraps
+the call in ``shard_map`` over the trial mesh (batch on "dp", heads on
+"tp") via :func:`sharded_flash_attention` — attention is embarrassingly
+parallel over (batch, head), so each shard runs the kernel locally and the
+Megatron head split survives instead of GSPMD all-gathering q/k/v.
 """
 
 from __future__ import annotations
@@ -32,13 +49,33 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _NEG_BIG = -1e30
+_SUBLANE = 8  # pad granularity for sequences shorter than a block
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int):
+# ---------------------------------------------------------------------------
+# blocking / padding
+
+
+def _block_and_pad(size: int, target: int) -> tuple:
+    """(block, padded_size): block ≤ target, padded_size % block == 0."""
+    if size % target == 0:
+        return target, size
+    if size < target:
+        p = -(-size // _SUBLANE) * _SUBLANE
+        return p, p
+    return target, -(-size // target) * target
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                      *, block_k: int):
     """One (batch·head, q-block) program: online softmax over K blocks.
 
-    Shapes in VMEM: q (1, Bq, D); k/v (1, Sk, D); mask (1, Bq, Sk) bool or
-    None; o (1, Bq, D).
+    Shapes in VMEM: q (1, Bq, D); k/v (1, Sk, D); mask (1, Bq, Sk) int8 or
+    None; o (1, Bq, D); lse (1, Bq).
     """
     q = q_ref[0].astype(jnp.float32)                      # (Bq, D)
     bq, d = q.shape
@@ -75,39 +112,28 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int):
         )
         return m_new, l_new, acc_new
 
-    _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
-    # fully-masked rows have l == 0; emit zeros rather than NaNs
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    # fully-masked rows have l == 0; emit zeros rather than NaNs, and an
+    # lse of +inf so the blockwise backward recomputes p == 0 for them
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(
+        l[:, 0] > 0, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)), jnp.inf
+    )
 
 
-def _pick_block(size: int, target: int) -> int:
-    if size % target == 0:
-        return target
-    return size  # irregular lengths: single block (demo seqs are short)
-
-
-def _flash_forward(
-    q: jnp.ndarray,                 # (B, Sq, H, D)
-    k: jnp.ndarray,                 # (B, Sk, H, D)
-    v: jnp.ndarray,                 # (B, Sk, H, D)
-    mask: Optional[jnp.ndarray],    # (B, Sq, Sk) bool or None
-    block_q: int,
-    block_k: int,
-    interpret: bool,
-) -> jnp.ndarray:
+def _pallas_forward(q, k, v, mask, block_q, block_k, interpret):
+    """(out, lse) via the Pallas kernel. Shapes pre-padded to block multiples."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    bq = _pick_block(sq, block_q)
-    bk = _pick_block(sk, block_k)
 
     # head-major flattening: one grid row per (batch, head)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
-    grid = (b * h, sq // bq)
+    grid = (b * h, sq // block_q)
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
         pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
     ]
@@ -115,27 +141,183 @@ def _flash_forward(
     if mask is not None:
         in_specs.append(
             # mask is per-batch (heads share it): index by bh // h
-            pl.BlockSpec((1, bq, sk), lambda bh, qi, h=h: (bh // h, qi, 0))
+            pl.BlockSpec((1, block_q, sk), lambda bh, qi, h=h: (bh // h, qi, 0))
         )
         operands.append(mask.astype(jnp.int8))
-        kernel = functools.partial(_flash_fwd_kernel, block_k=bk)
+        kernel = functools.partial(_flash_fwd_kernel, block_k=block_k)
     else:
-        def kernel(q_ref, k_ref, v_ref, o_ref):
-            _flash_fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, block_k=bk)
+        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+            _flash_fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                              block_k=block_k)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
         interpret=interpret,
     )(*operands)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return (out.reshape(b, h, sq, d).transpose(0, 2, 1, 3),
+            lse.reshape(b, h, sq))
 
 
-def _reference_attention(q, k, v, mask):
-    """Plain XLA attention (f32 softmax) — backward path + fallbacks."""
+# ---------------------------------------------------------------------------
+# chunked (lax.scan) twin — pure XLA, any backend, dropout-capable
+
+
+def _dropout_tile(key, i, keep, shape):
+    """The (fwd ∩ bwd)-shared dropout mask for K-block i."""
+    return jax.random.bernoulli(jax.random.fold_in(key, i), keep, shape)
+
+
+def _chunked_forward(q, k, v, mask, block_k, dropout_rate, key):
+    """(out, lse) via a lax.scan over K blocks; live tiles O(Sq·block_k).
+
+    Dropout semantics match ``dropout(softmax(s)) @ V``: the denominator l
+    accumulates undropped probabilities; the accumulator takes the dropped,
+    1/keep-scaled ones.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    nk = sk // block_k
+    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)      # (b,h,sq,d)
+    kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)      # (b,h,sk,d)
+    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    keep = 1.0 - dropout_rate
+
+    def body(carry, i):
+        m, l, acc = carry
+        kb = jax.lax.dynamic_slice_in_dim(kt, i * block_k, block_k, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(vt, i * block_k, block_k, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kb,
+                       preferred_element_type=jnp.float32)
+        if mask is not None:
+            mb = jax.lax.dynamic_slice_in_dim(mask, i * block_k, block_k,
+                                              axis=2)
+            s = jnp.where(mb[:, None], s, _NEG_BIG)
+        m_new = jnp.maximum(
+            jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True)), 0.5 * _NEG_BIG
+        )
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            pm = _dropout_tile(key, i, keep, p.shape)
+            p = jnp.where(pm, p / keep, 0.0)
+        acc_new = alpha * acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vb, preferred_element_type=jnp.float32
+        )
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), jnp.arange(nk))
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    lse = jnp.where(
+        l[..., 0] > 0, m[..., 0] + jnp.log(jnp.maximum(l[..., 0], 1e-30)),
+        jnp.inf,
+    )
+    return out.transpose(0, 2, 1, 3), lse                 # (b,sq,h,d), (b,h,sq)
+
+
+def _chunked_backward(q, k, v, mask, key, out, lse, g, block_k, dropout_rate):
+    """Blockwise VJP from saved lse: p-tiles recomputed per K block.
+
+    Softmax VJP with post-normalization dropout: with y = softmax rows and
+    O = (pm/keep ⊙ y) V, the row term Σⱼ yⱼ·(dL/dyⱼ) collapses to
+    rowsum(dO ⊙ O) — the standard delta trick survives dropout because the
+    mask rides inside both factors.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    nk = sk // block_k
+    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    gt = g.transpose(0, 2, 1, 3).astype(jnp.float32)
+    ot = out.transpose(0, 2, 1, 3).astype(jnp.float32)
+    delta = jnp.sum(gt * ot, axis=-1, keepdims=True)      # (b,h,sq,1)
+    keep = 1.0 - dropout_rate
+
+    def body(dq, i):
+        kb = jax.lax.dynamic_slice_in_dim(kt, i * block_k, block_k, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(vt, i * block_k, block_k, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kb,
+                       preferred_element_type=jnp.float32)
+        if mask is not None:
+            mb = jax.lax.dynamic_slice_in_dim(mask, i * block_k, block_k,
+                                              axis=2)
+            s = jnp.where(mb[:, None], s, _NEG_BIG)
+        p = jnp.exp(s - lse[..., None])                   # normalized probs
+        gp = jnp.einsum("bhqd,bhkd->bhqk", gt, vb,
+                        preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            pm = _dropout_tile(key, i, keep, p.shape)
+            pd = jnp.where(pm, p / keep, 0.0)
+            gp = jnp.where(pm, gp / keep, 0.0)
+        else:
+            pd = p
+        dv_i = jnp.einsum("bhqk,bhqd->bhkd", pd, gt,
+                          preferred_element_type=jnp.float32)
+        ds = p * (gp - delta)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kb,
+                             preferred_element_type=jnp.float32)
+        dk_i = jnp.einsum("bhqk,bhqd->bhkd", ds, qt,
+                          preferred_element_type=jnp.float32)
+        return dq, (dk_i, dv_i)
+
+    dq0 = jnp.zeros_like(qt)
+    dq, (dks, dvs) = jax.lax.scan(body, dq0, jnp.arange(nk))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, sk, d)     # (nk,b,h,bk,d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, sk, d)
+    to_in = lambda t, ref: t.transpose(0, 2, 1, 3).astype(ref.dtype)  # noqa: E731
+    return to_in(dq, q), to_in(dk, k), to_in(dv, v)
+
+
+# ---------------------------------------------------------------------------
+# custom VJP plumbing
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, mask, key, dropout_rate, block_q, block_k, impl,
+           interpret):
+    out, _ = _flash_fwd_rule(
+        q, k, v, mask, key, dropout_rate, block_q, block_k, impl, interpret
+    )
+    return out
+
+
+def _flash_fwd_rule(q, k, v, mask, key, dropout_rate, block_q, block_k, impl,
+                    interpret):
+    if impl == "pallas":
+        out, lse = _pallas_forward(q, k, v, mask, block_q, block_k, interpret)
+    else:
+        out, lse = _chunked_forward(q, k, v, mask, block_k, dropout_rate, key)
+    return out, (q, k, v, mask, key, out, lse)
+
+
+def _flash_bwd_rule(dropout_rate, block_q, block_k, impl, interpret,
+                    residuals, g):
+    q, k, v, mask, key, out, lse = residuals
+    dq, dk, dv = _chunked_backward(
+        q, k, v, mask, key, out, lse, g, block_k, dropout_rate
+    )
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _reference_attention(q, k, v, mask, dropout_rate=0.0, dropout_key=None):
+    """Plain XLA attention (f32 softmax) — the O(S²)-HBM fallback/oracle."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     if mask is not None:
         s = jnp.where(mask[:, None], s, _NEG_BIG)
@@ -144,31 +326,15 @@ def _reference_attention(q, k, v, mask):
     if mask is not None:
         any_valid = jnp.any(mask[:, None], axis=-1, keepdims=True)
         p = jnp.where(any_valid, p, 0.0)
+    if dropout_rate > 0.0:
+        keep = 1.0 - dropout_rate
+        pm = jax.random.bernoulli(dropout_key, keep, p.shape)
+        p = jnp.where(pm, p / keep, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, mask, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, mask, block_q, block_k, interpret)
-
-
-def _flash_fwd_rule(q, k, v, mask, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, mask, block_q, block_k, interpret)
-    return out, (q, k, v, mask)
-
-
-def _flash_bwd_rule(block_q, block_k, interpret, residuals, g):
-    q, k, v, mask = residuals
-    # recompute-backward: differentiate the reference formulation
-    def f(q_, k_, v_):
-        return _reference_attention(q_, k_, v_, mask)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv, None
-
-
-_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+# ---------------------------------------------------------------------------
+# public API
 
 
 def flash_attention(
@@ -177,32 +343,136 @@ def flash_attention(
     v: jnp.ndarray,
     mask: Optional[jnp.ndarray] = None,
     *,
+    dropout_rate: float = 0.0,
+    dropout_key: Optional[jnp.ndarray] = None,
     block_q: int = 128,
     block_k: int = 128,
+    impl: Optional[str] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Blocked online-softmax attention.
+    """Blocked online-softmax attention with a blockwise backward.
 
     q: (B, Sq, H, D) — pre-scaled (multiply by 1/sqrt(D) before calling);
     k, v: (B, Sk, H, D); mask: optional (B, Sq, Sk) bool, True = attend
-    (shared across heads). Returns (B, Sq, H, D) in q's dtype.
+    (shared across heads); dropout_rate applies to attention probabilities
+    (chunked impl only) with dropout_key. Irregular Sq/Sk are padded to
+    block multiples with masked tails. Returns (B, Sq, H, D) in q's dtype.
     """
+    if impl is None:
+        impl = "chunked" if dropout_rate > 0.0 else "pallas"
+    if dropout_rate > 0.0 and impl == "pallas":
+        raise ValueError("attention dropout requires impl='chunked'")
+    if dropout_rate > 0.0 and dropout_key is None:
+        raise ValueError("dropout_rate > 0 needs a dropout_key")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, mask, block_q, block_k, interpret)
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq, sq_p = _block_and_pad(sq, block_q)
+    bk, sk_p = _block_and_pad(sk, block_k)
+    if sq_p != sq or sk_p != sk:
+        q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, 0), (0, 0)))
+        if mask is not None:
+            mask = jnp.pad(mask, ((0, 0), (0, sq_p - sq), (0, sk_p - sk)))
+        elif sk_p != sk:
+            # padded K columns must not be attended; padded Q rows are
+            # sliced off below and need no masking
+            mask = jnp.broadcast_to(
+                (jnp.arange(sk_p) < sk)[None, None, :], (b, sq_p, sk_p)
+            )
+    out = _flash(q, k, v, mask, dropout_key, float(dropout_rate), bq, bk,
+                 impl, bool(interpret))
+    return out[:, :sq]
+
+
+def sharded_flash_attention(
+    mesh,
+    q, k, v,
+    mask=None,
+    *,
+    dropout_rate: float = 0.0,
+    dropout_key=None,
+    impl: Optional[str] = None,
+    batch_axis: str = "dp",
+    head_axis: str = "tp",
+    **kwargs,
+):
+    """shard_map the kernel over the trial mesh: batch on dp, heads on tp.
+
+    Attention is embarrassingly parallel over (batch, head): each shard runs
+    the kernel on its local (B/dp, S, H/tp, D) slab with zero collectives,
+    so the Megatron column-split of q/k/v survives instead of GSPMD
+    all-gathering the heads. The dropout key is decorrelated per shard by
+    folding in the mesh coordinates.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-promotion JAX
+        from jax.experimental.shard_map import shard_map
+
+    ab = batch_axis if batch_axis in mesh.shape else None
+    ah = head_axis if head_axis in mesh.shape else None
+    qs = P(ab, None, ah, None)
+    ms = P(ab, None, None)
+
+    def local(q, k, v, mask, key):
+        if key is not None:
+            for ax in (ab, ah):
+                if ax is not None:
+                    key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+        return flash_attention(
+            q, k, v, mask, dropout_rate=dropout_rate, dropout_key=key,
+            impl=impl, **kwargs,
+        )
+
+    kw = dict(
+        mesh=mesh,
+        in_specs=(qs, qs, qs, ms if mask is not None else P(), P()),
+        out_specs=qs,
+    )
+    try:
+        # the scan carries start mesh-invariant and become varying in the
+        # body — sound here (zero-init online-softmax stats), so opt out
+        # of the replication/vma check under whichever name this JAX uses
+        wrapped = shard_map(local, check_vma=False, **kw)
+    except TypeError:
+        wrapped = shard_map(local, check_rep=False, **kw)
+    return wrapped(q, k, v, mask, dropout_key)
+
+
+def attention_impl() -> Optional[str]:
+    """Which implementation MHA routes through, from ``METAOPT_TPU_FLASH``.
+
+    - unset/``0``/``off`` → ``None``: plain XLA reference attention.
+      Deliberately the default: the axon relay's remote-compile path cannot
+      build Mosaic (Pallas) programs — even a trivial pallas_call hangs —
+      so routing every Transformer trial through the Pallas kernel would
+      wedge on that setup.
+    - ``1``/``pallas`` → the Pallas kernel (Mosaic on a directly-attached
+      TPU; interpret mode elsewhere). Attention dropout still routes those
+      calls to the chunked twin.
+    - ``chunked``/``scan`` → the lax.scan twin: compiles on any backend,
+      including through the axon relay — the production training path
+      there.
+    """
+    env = (os.environ.get("METAOPT_TPU_FLASH") or "").strip().lower()
+    if env in ("", "0", "false", "no", "off"):
+        return None
+    if env in ("chunked", "scan", "2"):
+        return "chunked"
+    if env in ("1", "true", "yes", "on", "pallas"):
+        return "pallas"
+    # a typo must not silently select the Mosaic path (which wedges on
+    # relay-tunneled backends) — fail loudly instead
+    raise ValueError(
+        f"METAOPT_TPU_FLASH={env!r}: expected off/pallas/chunked"
+    )
 
 
 def use_flash_attention() -> bool:
-    """Route MHA through the kernel? Opt-in via METAOPT_TPU_FLASH=1.
-
-    Deliberately NOT default-on for the TPU backend: the axon tunnel's
-    remote-compile path cannot currently build Mosaic (Pallas) programs —
-    even a trivial pallas_call hangs — so silently routing every
-    Transformer trial through the kernel would wedge on that setup. On a
-    directly-attached TPU runtime, set METAOPT_TPU_FLASH=1 (the executor
-    forwards the env to trials).
-    """
-    env = os.environ.get("METAOPT_TPU_FLASH")
-    if env is not None:
-        return env.strip().lower() not in ("0", "false", "no", "off", "")
-    return False
+    """Back-compat boolean view of :func:`attention_impl`."""
+    return attention_impl() is not None

@@ -2,12 +2,51 @@
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+_ACTIVE_MESH: contextvars.ContextVar = contextvars.ContextVar(
+    "mtpu_active_mesh", default=None
+)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Activate a mesh: legacy ``with mesh:`` semantics + model-layer access.
+
+    The model layer (MHA's shard_map routing) reads the active mesh via
+    :func:`active_mesh` rather than probing the deprecated
+    ``jax.interpreters.pxla.thread_resources`` — this context is the
+    supported registration point, and it works for both activation styles
+    (the legacy context manager is entered here; new-style
+    ``jax.sharding.use_mesh`` callers are caught by the abstract-mesh
+    probe in :func:`active_mesh`).
+    """
+    token = _ACTIVE_MESH.set(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _ACTIVE_MESH.reset(token)
+
+
+def active_mesh() -> Optional[Mesh]:
+    """The mesh the current trial runs under, or None outside any mesh."""
+    mesh = _ACTIVE_MESH.get()
+    if mesh is not None:
+        return mesh
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        abstract = get_abstract()
+        if abstract is not None and not abstract.empty:
+            return abstract
+    return None
 
 
 def trial_devices() -> List[jax.Device]:
@@ -24,11 +63,27 @@ def trial_devices() -> List[jax.Device]:
     if not spec:
         return list(devices)
     want = [int(s) for s in spec.split(",") if s != ""]
+    if len(set(want)) != len(want):
+        raise ValueError(f"MTPU_ASSIGNED_CHIPS={spec!r} repeats a chip id")
     by_id = {d.id: d for d in devices}
     if all(i in by_id for i in want):
         picked = [by_id[i] for i in want]
-    else:  # ids are slice-relative; index into the visible list
-        picked = [devices[i % len(devices)] for i in want]
+    elif all(i < len(devices) for i in want):
+        # ids are slice-relative; index into the visible list
+        picked = [devices[i] for i in want]
+    elif len(want) == len(devices):
+        # a pinned runtime honored TPU_VISIBLE_CHIPS and renumbered: the
+        # assignment ids are global block ids, but the visible set IS
+        # exactly the assignment — take it whole, each device once
+        picked = list(devices)
+    else:
+        # never modulo-wrap: that would silently put the same device into
+        # the mesh twice and corrupt every collective on it
+        raise ValueError(
+            f"MTPU_ASSIGNED_CHIPS={spec!r} matches no visible device id, "
+            f"exceeds the visible index range, and its size differs from "
+            f"the {len(devices)} visible devices — cannot map safely"
+        )
     # a pinned runtime that already hides other chips needs no filtering
     return picked or list(devices)
 

@@ -19,7 +19,7 @@ from metaopt_tpu.algo.base import BaseAlgorithm, make_algorithm
 from metaopt_tpu.executor.base import Executor
 from metaopt_tpu.ledger.experiment import Experiment
 from metaopt_tpu.ledger.trial import Trial
-from metaopt_tpu.worker.producer import Producer
+from metaopt_tpu.worker.producer import Producer, RemoteProducer
 
 log = logging.getLogger(__name__)
 
@@ -47,15 +47,27 @@ def workon(
     heartbeat_timeout_s: float = 60.0,
     idle_sleep_s: float = 0.05,
     max_idle_cycles: int = 200,
+    producer_mode: str = "local",
 ) -> WorkerStats:
     """Run trials until the experiment finishes (or this worker's cap hits).
 
     ``max_broken`` (the reference's worker guard) stops this worker once that
     many trials have broken — a persistently-crashing user script must not
     spin the produce→break loop forever.
+
+    ``producer_mode="coord"`` delegates suggestion (and the judge hook) to
+    the coordinator's single hosted algorithm instance instead of fitting a
+    local copy — requires the ``coord://`` ledger backend.
     """
-    algo = algorithm or make_algorithm(experiment.space, experiment.algorithm)
-    producer = Producer(experiment, algo)
+    algo: Optional[BaseAlgorithm]
+    if producer_mode == "coord":
+        producer: Any = RemoteProducer(experiment, worker=worker_id)
+        algo = None
+    elif producer_mode == "local":
+        algo = algorithm or make_algorithm(experiment.space, experiment.algorithm)
+        producer = Producer(experiment, algo)
+    else:
+        raise ValueError(f"unknown producer_mode {producer_mode!r}")
     stats = WorkerStats()
 
     def heartbeat_for(trial: Trial):
@@ -64,6 +76,8 @@ def workon(
         return beat
 
     def judge_fn(trial: Trial, partial: List[Dict[str, Any]]):
+        if algo is None:
+            return producer.judge(trial, partial)
         return algo.judge(trial, partial)
 
     while not experiment.is_done:
@@ -87,7 +101,7 @@ def workon(
             in_flight = experiment.count("reserved")
             if produced == 0 and in_flight == 0:
                 stats.idle_cycles += 1
-                if algo.is_done or stats.idle_cycles > max_idle_cycles:
+                if producer.algo_done or stats.idle_cycles > max_idle_cycles:
                     log.info("%s: no work producible; stopping", worker_id)
                     break
             else:
@@ -140,7 +154,9 @@ def workon(
             }
         )
 
-    # final observe so the algorithm state is current for callers
-    algo.observe(experiment.fetch_completed_trials())
+    # final observe so the algorithm state is current for callers (the
+    # coordinator-hosted algorithm observes inside its own produce cycles)
+    if algo is not None:
+        algo.observe(experiment.fetch_completed_trials())
     stats.producer_timings = dict(producer.timings)
     return stats

@@ -25,6 +25,8 @@ class Producer:
         self.timings: Dict[str, float] = {
             "observe_s": 0.0, "suggest_s": 0.0, "cycles": 0, "suggested": 0,
         }
+        #: mirrored by RemoteProducer so workon need not touch the algorithm
+        self.algo_done = False
 
     def produce(self, pool_size: Optional[int] = None) -> int:
         """One observe→suggest→register cycle; returns #trials registered."""
@@ -35,6 +37,7 @@ class Producer:
         self.timings["cycles"] += 1
 
         if self.algorithm.is_done:
+            self.algo_done = True
             exp.mark_algo_done()
             return 0
 
@@ -60,3 +63,46 @@ class Producer:
                 len(trials) - len(kept), len(trials),
             )
         return len(kept)
+
+
+class RemoteProducer:
+    """Producer facade that delegates the cycle to the coordinator.
+
+    The BASELINE north star's "KDE fit on a coordinator chip": the
+    coordinator owns ONE algorithm instance per experiment (see
+    ``CoordServer._hosted_producer``); workers just ask it to produce and
+    then reserve as usual. N workers therefore share one fitted surrogate —
+    no redundant per-worker re-fits, no divergent suggestion streams — while
+    the decentralized :class:`Producer` remains the fallback for ledger
+    backends with no coordinator (memory/file/native).
+    """
+
+    def __init__(self, experiment: Experiment, worker: Optional[str] = None):
+        ledger = experiment.ledger
+        if not hasattr(ledger, "produce"):
+            raise ValueError(
+                "coordinator-hosted suggestion needs the coord:// ledger "
+                f"backend (got {type(ledger).__name__})"
+            )
+        self.experiment = experiment
+        self.worker = worker
+        self.timings: Dict[str, float] = {
+            "produce_rpc_s": 0.0, "cycles": 0, "suggested": 0, "remote": 1,
+        }
+        self.algo_done = False
+
+    def produce(self, pool_size: Optional[int] = None) -> int:
+        t0 = time.perf_counter()
+        out = self.experiment.ledger.produce(
+            self.experiment.name,
+            pool_size or self.experiment.pool_size,
+            worker=self.worker,
+        )
+        self.timings["produce_rpc_s"] += time.perf_counter() - t0
+        self.timings["cycles"] += 1
+        self.timings["suggested"] += out["registered"]
+        self.algo_done = bool(out.get("algo_done"))
+        return out["registered"]
+
+    def judge(self, trial, partial):
+        return self.experiment.ledger.judge(self.experiment.name, trial, partial)

@@ -19,13 +19,15 @@ from metaopt_tpu.space import build_space
 from metaopt_tpu.worker import workon
 
 
-def _worker(ledger_cfg: dict, worker_id: str, out_path: str) -> None:
+def _worker(ledger_cfg: dict, worker_id: str, out_path: str,
+            producer_mode: str = "local") -> None:
     exp = Experiment("race", make_ledger(ledger_cfg)).configure()
     stats = workon(
         exp,
         InProcessExecutor(lambda p: (p["x"] - 1.0) ** 2),
         worker_id=worker_id,
         max_idle_cycles=50,
+        producer_mode=producer_mode,
     )
     with open(out_path, "w") as f:
         json.dump({"completed": stats.completed, "events": stats.events}, f)
@@ -111,3 +113,51 @@ def test_four_workers_against_one_coordinator(tmp_path):
         exp = Experiment("race", ledger).configure()
         assert exp.count("completed") == 24
         assert exp.is_done
+
+
+def test_eight_workers_hosted_producer_race(tmp_path):
+    """Pod-like worker count (8) hammering one coordinator, all delegating
+    suggestion to the single hosted algorithm (producer_mode="coord").
+
+    Totals are ``>=``: the budget check is read-then-register racy across
+    produce/push interleavings; no-duplicate-execution is the invariant.
+    """
+    from metaopt_tpu.coord import CoordServer
+
+    with CoordServer() as server:
+        host, port = server.address
+        ledger = make_ledger({"type": "coord", "host": host, "port": port})
+        Experiment(
+            "race", ledger,
+            space=build_space({"x": "uniform(-5, 5)"}),
+            max_trials=40, pool_size=4,
+            algorithm={"tpe": {"seed": 11, "n_initial_points": 6}},
+        ).configure()
+
+        ctx = mp.get_context("spawn")
+        outs = [str(tmp_path / f"hw{i}.json") for i in range(8)]
+        ledger_cfg = {"type": "coord", "host": host, "port": port}
+        procs = [
+            ctx.Process(
+                target=_worker, args=(ledger_cfg, f"w{i}", outs[i], "coord")
+            )
+            for i in range(8)
+        ]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=240)
+            assert p.exitcode == 0
+
+        per_worker = [json.load(open(o)) for o in outs]
+        total = sum(w["completed"] for w in per_worker)
+        executed = [e["trial"] for w in per_worker for e in w["events"]]
+        assert len(executed) == len(set(executed)), "a trial ran on two workers"
+        assert total >= 40
+
+        # exactly one hosted algorithm drove all eight workers, and it
+        # observed (at least) every completion the ledger holds
+        assert list(server._producers) == ["race"]
+        exp = Experiment("race", ledger).configure()
+        assert exp.count("completed") >= 40
+        assert exp.count("completed") <= 40 + 8 * 4  # bounded overshoot

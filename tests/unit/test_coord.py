@@ -288,3 +288,124 @@ class TestExperimentOverCoord:
         stats = workon(exp, InProcessExecutor(lambda p: (p["x"] - 1) ** 2))
         assert stats.completed == 12
         assert exp.stats["best"]["objective"] >= 0.0
+
+
+class TestHostedProducer:
+    """Coordinator-hosted suggestion — the north star's centralized surrogate."""
+
+    def _exp(self, c, name="hosted", algo=None, max_trials=12, pool_size=3):
+        from metaopt_tpu.space import build_space
+
+        return Experiment(
+            name, c, space=build_space({"x": "uniform(-5, 5)"}),
+            max_trials=max_trials, pool_size=pool_size,
+            algorithm=algo or {"random": {"seed": 1}},
+        ).configure()
+
+    def test_produce_registers_on_single_hosted_algo(self, server):
+        c = _client(server)
+        self._exp(c)
+        out = c.produce("hosted", pool_size=3)
+        assert out["registered"] == 3
+        assert len(c.fetch("hosted", "new")) == 3
+        c.produce("hosted", pool_size=3)
+        # one hosted producer instance, not one per client call
+        assert list(server._producers) == ["hosted"]
+
+    def test_produce_unknown_experiment_raises(self, server):
+        c = _client(server)
+        with pytest.raises(KeyError):
+            c.produce("nope")
+
+    def test_produce_rejected_when_hosting_disabled(self):
+        with CoordServer(host_algorithms=False) as s:
+            c = _client(s)
+            self._exp(c)
+            from metaopt_tpu.coord.client_backend import CoordRPCError
+
+            with pytest.raises((ValueError, CoordRPCError)):
+                c.produce("hosted")
+
+    def test_workon_coord_mode_end_to_end(self, server):
+        from metaopt_tpu.executor import InProcessExecutor
+        from metaopt_tpu.worker import workon
+
+        c = _client(server)
+        exp = self._exp(c, name="coordmode")
+        stats = workon(
+            exp, InProcessExecutor(lambda p: (p["x"] - 1) ** 2),
+            producer_mode="coord",
+        )
+        assert stats.completed == 12
+        assert stats.producer_timings.get("remote") == 1
+        # the worker never fit a local algorithm; the hosted one did the work
+        assert "coordmode" in server._producers
+
+    def test_tpe_hosted_single_fit_stream(self, server):
+        """N workers against one hosted TPE: every suggestion comes from the
+        same fitted instance and duplicates are ~0 (ledger saw no drops)."""
+        from metaopt_tpu.executor import InProcessExecutor
+        from metaopt_tpu.worker import workon
+
+        c = _client(server)
+        exp = self._exp(
+            c, name="tpe-hosted",
+            algo={"tpe": {"seed": 3, "n_initial_points": 4}},
+            max_trials=10, pool_size=2,
+        )
+        errs = []
+
+        def run(i):
+            try:
+                cli = _client(server)
+                e = Experiment("tpe-hosted", cli).configure()
+                workon(
+                    e, InProcessExecutor(lambda p: (p["x"] - 1) ** 2),
+                    worker_id=f"w{i}", producer_mode="coord",
+                )
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs
+        done = c.fetch("tpe-hosted", "completed")
+        assert len(done) >= 10
+        # exactly one hosted algorithm served all three workers
+        assert list(server._producers) == ["tpe-hosted"]
+        algo = server._producers["tpe-hosted"][0].algorithm
+        assert len(done) <= len(algo._observed) + exp.pool_size
+
+    def test_hosted_judge_roundtrip(self, server):
+        c = _client(server)
+        self._exp(c, name="judged", algo={"random": {"seed": 5}})
+        t = Trial(params={"x": 1.0}, experiment="judged")
+        # random's judge is a no-op → None over RPC
+        assert c.judge("judged", t, [{"name": "loss", "type": "objective",
+                                      "value": 1.0}]) is None
+
+    def test_hosted_state_survives_restart_by_observe_replay(self, tmp_path):
+        from metaopt_tpu.executor import InProcessExecutor
+        from metaopt_tpu.worker import workon
+
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap) as s1:
+            c = _client(s1)
+            exp = self._exp(c, name="resume", max_trials=6)
+            workon(exp, InProcessExecutor(lambda p: (p["x"] - 1) ** 2),
+                   producer_mode="coord", worker_trials=3)
+            s1.snapshot(snap)
+        with CoordServer(snapshot_path=snap) as s2:
+            c2 = _client(s2)
+            assert s2._producers == {}  # fresh process, no hosted state yet
+            exp2 = Experiment("resume", c2).configure()
+            workon(exp2, InProcessExecutor(lambda p: (p["x"] - 1) ** 2),
+                   producer_mode="coord")
+            done = c2.fetch("resume", "completed")
+            assert len(done) >= 6
+            # the rebuilt hosted algorithm replayed the restored completions
+            algo = s2._producers["resume"][0].algorithm
+            assert len(algo._observed) >= 3

@@ -78,6 +78,41 @@ class TestTransformer:
         )
         assert np.isfinite(loss) and loss > 0
 
+    def test_flash_routed_under_tp_mesh(self, monkeypatch):
+        """tp>1 no longer bypasses the kernel: the chunked flash path (plus
+        attention-weight dropout) trains under a dp×tp mesh via shard_map."""
+        monkeypatch.setenv("METAOPT_TPU_FLASH", "chunked")
+        from metaopt_tpu.models.transformer import train_and_eval
+        from metaopt_tpu.parallel import make_mesh
+
+        mesh = make_mesh([("dp", 2), ("tp", 4)])
+        loss = train_and_eval(
+            {"d_model": 32, "n_heads": 4, "n_layers": 1, "d_ff": 64,
+             "vocab": 97, "lr": 1e-3, "dropout": 0.1},
+            mesh=mesh, n_train=32, batch_size=8, seq_len=12, steps=2,
+        )
+        assert np.isfinite(loss) and loss > 0
+
+    def test_attention_dropout_active_in_train(self):
+        """Two train-mode applies with different dropout keys differ; eval
+        mode is deterministic (attention-weight dropout is live)."""
+        import jax
+        import jax.numpy as jnp
+        from metaopt_tpu.models.transformer import make_model
+
+        model = make_model({"d_model": 32, "n_heads": 2, "n_layers": 1,
+                            "d_ff": 64, "vocab": 50, "dropout": 0.3})
+        src = jnp.ones((2, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), src, src, train=False)
+        a = model.apply(params, src, src, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(1)})
+        b = model.apply(params, src, src, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(2)})
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+        c = model.apply(params, src, src, train=False)
+        d = model.apply(params, src, src, train=False)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(d))
+
     def test_tp_kernels_actually_sharded(self):
         import jax.numpy as jnp
         import optax

@@ -8,6 +8,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from metaopt_tpu.parallel import make_mesh, shard_batch, trial_devices, trial_mesh
+from metaopt_tpu.parallel.mesh import active_mesh, use_mesh
 
 
 def test_virtual_mesh_has_8_devices():
@@ -38,6 +39,29 @@ def test_trial_mesh_over_subslice(monkeypatch):
     m = trial_mesh(tp=2)
     assert m.shape == {"dp": 2, "tp": 2}
     assert {d.id for d in m.devices.flat} == {4, 5, 6, 7}
+
+
+def test_trial_devices_rejects_out_of_range_ids(monkeypatch):
+    # slice-relative ids beyond the visible count must raise, never
+    # modulo-wrap onto an already-used device
+    monkeypatch.setenv("MTPU_ASSIGNED_CHIPS", "100,101")
+    with pytest.raises(ValueError, match="exceed"):
+        trial_devices()
+
+
+def test_trial_devices_rejects_duplicate_ids(monkeypatch):
+    monkeypatch.setenv("MTPU_ASSIGNED_CHIPS", "1,1,2")
+    with pytest.raises(ValueError, match="repeats"):
+        trial_devices()
+
+
+def test_active_mesh_context():
+    assert active_mesh() is None
+    m = make_mesh([("dp", 4), ("tp", 2)])
+    with use_mesh(m) as entered:
+        assert entered is m
+        assert active_mesh() is m
+    assert active_mesh() is None
 
 
 def test_shard_batch_places_on_dp():
