@@ -43,6 +43,12 @@ def preflight_backend(timeout_s: float = 90.0) -> None:
     """
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        # the axon sitecustomize's register() at interpreter startup can
+        # override the env-var platform selection — re-apply via the live
+        # config or the first device init still dials the (wedgeable) relay
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
         return
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return
@@ -311,7 +317,15 @@ def main() -> None:
     tpe._suggest_one_ei()
     pool_ms = time_fn(lambda: tpe.suggest(pool), repeats=20)
     jax_ms = pool_ms / pool
-    single_ms = time_fn(tpe._suggest_one_ei, repeats=20)
+    # amortized single-suggest: a full prefetch cycle (one launch +
+    # pool_prefetch-1 cache pops) divided by the points served — the cost a
+    # worker asking for one point at a time actually pays per point — vs
+    # the raw one-launch-per-point path
+    pp = tpe.pool_prefetch
+    single_ms = time_fn(
+        lambda: [tpe._suggest_one_ei() for _ in range(pp)], repeats=10
+    ) / pp
+    single_uncached_ms = time_fn(lambda: tpe._launch_ei(1), repeats=10)
 
     # the reference substrate refits + rescores per suggestion (host numpy)
     numpy_ms = time_fn(lambda: numpy_ei_reference(tpe), repeats=5)
@@ -339,6 +353,7 @@ def main() -> None:
         "extra": {
             "numpy_reference_ms_per_point": round(numpy_ms, 3),
             "single_suggest_ms": round(single_ms, 3),
+            "single_suggest_uncached_ms": round(single_uncached_ms, 3),
             "jax_1k_obs_ms_per_point": round(jax_1k_ms, 3),
             "flatness_10k_over_1k": round(jax_ms / max(jax_1k_ms, 1e-9), 2),
             "backend": jax.default_backend(),

@@ -50,6 +50,7 @@ class TPE(BaseAlgorithm):
         prior_weight: float = 1.0,
         full_weight_num: int = 25,
         equal_weight: bool = False,
+        pool_prefetch: int = 8,
         **config: Any,
     ):
         super().__init__(
@@ -61,6 +62,7 @@ class TPE(BaseAlgorithm):
             prior_weight=prior_weight,
             full_weight_num=full_weight_num,
             equal_weight=equal_weight,
+            pool_prefetch=pool_prefetch,
             **config,
         )
         self.n_initial_points = n_initial_points
@@ -69,6 +71,7 @@ class TPE(BaseAlgorithm):
         self.prior_weight = prior_weight
         self.full_weight_num = full_weight_num
         self.equal_weight = equal_weight
+        self.pool_prefetch = max(1, int(pool_prefetch))
 
         self.cube = UnitCube(space)
         self._X: List[np.ndarray] = []   # unit-cube vectors, observation order
@@ -93,6 +96,12 @@ class TPE(BaseAlgorithm):
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
         self._base_key = None                     # PRNGKey, created lazily
         self._suggest_count = 0                   # PRNG stream position
+        #: prefetched suggestions from the last kernel launch, valid while
+        #: the fit is unchanged (same observation count). A worker asking
+        #: for ONE point then pays one launch per ``pool_prefetch`` points
+        #: instead of one blocking launch+readback per point.
+        self._prefetch: List[Dict[str, Any]] = []
+        self._prefetch_n_obs = -1
 
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:
@@ -228,6 +237,25 @@ class TPE(BaseAlgorithm):
         return self._suggest_ei(1)[0]
 
     def _suggest_ei(self, num: int) -> List[Dict[str, Any]]:
+        """Serve from the prefetch batch; refill with one kernel launch.
+
+        The fused kernel's cost is dominated by launch + blocking D2H
+        readback, not by the pool width (pooled vs single was 9ms vs 72ms
+        per point on the v5e) — so always compute ``max(num,
+        pool_prefetch)`` points per launch and serve later calls from the
+        leftovers while the fit is unchanged.
+        """
+        if self._prefetch_n_obs == len(self._y) and len(self._prefetch) >= num:
+            out, self._prefetch = self._prefetch[:num], self._prefetch[num:]
+            return out
+        batch = max(num, self.pool_prefetch)
+        points = self._launch_ei(batch)
+        out, rest = points[:num], points[num:]
+        self._prefetch = rest
+        self._prefetch_n_obs = len(self._y)
+        return out
+
+    def _launch_ei(self, num: int) -> List[Dict[str, Any]]:
         """One kernel launch + one readback for the whole pool of ``num``."""
         self._sync_device()
         if self._base_key is None:
@@ -282,6 +310,8 @@ class TPE(BaseAlgorithm):
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
         self._base_key = None
         self._suggest_count = 0
+        self._prefetch = []
+        self._prefetch_n_obs = -1
 
     # -- persistence -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
@@ -289,6 +319,11 @@ class TPE(BaseAlgorithm):
         s["X"] = [x.tolist() for x in self._X]
         s["y"] = list(self._y)
         s["suggest_count"] = self._suggest_count
+        # unserved prefetched points travel with the state: a restored
+        # instance must continue the exact suggestion stream, not skip the
+        # tail of the batch the live instance had already launched
+        s["prefetch"] = [dict(p) for p in self._prefetch]
+        s["prefetch_n_obs"] = self._prefetch_n_obs
         return s
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
@@ -298,3 +333,5 @@ class TPE(BaseAlgorithm):
         self._suggest_count = int(state.get("suggest_count", 0))
         self._cap = 0          # invalidate device mirror
         self._n_dev = -1
+        self._prefetch = [dict(p) for p in state.get("prefetch", [])]
+        self._prefetch_n_obs = int(state.get("prefetch_n_obs", -1))

@@ -64,6 +64,33 @@ class MHA(nn.Module):
             )
         rate = self.dropout if train else 0.0
         key = self.make_rng("dropout") if rate > 0.0 else None
+        out_proj = nn.DenseGeneral(
+            self.d_model, axis=(-2, -1), dtype=jnp.bfloat16, name="out",
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("tp", None, None)
+            ),
+        )
+
+        mesh = active_mesh()
+        if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
+            sp = mesh.shape["sp"]
+            if q.shape[1] % sp or k.shape[1] % sp:
+                # never silently fall back to sp-replicated attention: the
+                # user asked for sequence sharding, and the fallback would
+                # quietly pay the full O(S²) memory on every chip
+                raise ValueError(
+                    f"seq lengths (q={q.shape[1]}, kv={k.shape[1]}) must be "
+                    f"multiples of the sp mesh axis ({sp}); pad the batch "
+                    f"or drop sp from the trial mesh"
+                )
+            # sequence-parallel mesh: K/V ride the ICI ring, the quadratic
+            # logits never exist anywhere (long-context path)
+            from metaopt_tpu.ops.ring_attention import ring_attention
+
+            return out_proj(ring_attention(
+                q, k, v, m3, mesh=mesh,
+                dropout_rate=rate, dropout_key=key,
+            ))
 
         impl = attention_impl()
         if impl == "pallas" and rate > 0.0:
@@ -71,7 +98,6 @@ class MHA(nn.Module):
         if impl is None:
             out = _reference_attention(q, k, v, m3, rate, key)
         else:
-            mesh = active_mesh()
             if mesh is not None and getattr(mesh, "size", 1) > 1:
                 # batch on dp, heads on tp: keeps the Megatron head split
                 # local to each shard instead of GSPMD all-gathering q/k/v
@@ -84,12 +110,7 @@ class MHA(nn.Module):
                     q, k, v, m3,
                     dropout_rate=rate, dropout_key=key, impl=impl,
                 )
-        return nn.DenseGeneral(
-            self.d_model, axis=(-2, -1), dtype=jnp.bfloat16, name="out",
-            kernel_init=nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("tp", None, None)
-            ),
-        )(out)
+        return out_proj(out)
 
 
 class FeedForward(nn.Module):
@@ -280,6 +301,7 @@ def train_and_eval(
     *,
     mesh: Optional[Mesh] = None,
     tp: int = 1,
+    sp: int = 1,
     n_train: int = 2048,
     batch_size: int = 32,
     seq_len: int = 64,
@@ -289,7 +311,11 @@ def train_and_eval(
     """Train on the synthetic translation task; return final masked loss."""
     from metaopt_tpu.parallel.mesh import trial_mesh, use_mesh
 
-    mesh = mesh or trial_mesh(tp=tp)
+    # sp > 1 shards the sequence axis: attention runs as ring attention
+    # (K/V rotating over ICI), the long-context path
+    mesh = mesh or trial_mesh(
+        tp=tp, extra_axes=(("sp", sp),) if sp > 1 else ()
+    )
     model = make_model(hparams)
     lr = float(hparams.get("lr", 1e-3))
     warmup = int(hparams.get("warmup", 10))

@@ -177,6 +177,50 @@ def _dropout_tile(key, i, keep, shape):
     return jax.random.bernoulli(jax.random.fold_in(key, i), keep, shape)
 
 
+def online_softmax_fold(s, v, m, l, acc, drop=None, keep=1.0):
+    """Fold one masked score tile into online-softmax running statistics.
+
+    The single source of truth for the blockwise-attention update — used by
+    the chunked scan here AND by ring attention's per-hop step. s: (b, h,
+    sq, bk) scores with mask already applied as ``_NEG_BIG`` fills; v: (b,
+    h, bk, d); carries m/l: (b, h, sq, 1), acc: (b, h, sq, d). ``drop``
+    applies attention-probability dropout with ``dropout(softmax)``
+    semantics: l accumulates UNdropped mass (it is the softmax
+    denominator), acc takes the dropped/rescaled tiles.
+    """
+    # floor the running max above the mask fill: a fully-masked tile would
+    # otherwise get exp(s - m) = exp(0) = 1 (uniform attention)
+    m_new = jnp.maximum(
+        jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True)), 0.5 * _NEG_BIG
+    )
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    if drop is not None:
+        p = jnp.where(drop, p / keep, 0.0)
+    acc_new = alpha * acc + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v, preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def shard_map_nocheck(fn, mesh, in_specs, out_specs):
+    """shard_map without the replication/vma check, on whichever JAX API.
+
+    The blockwise-attention scans start their carries mesh-invariant and
+    make them varying in the body — sound here, but the checker (named
+    ``check_vma`` on newer JAX, ``check_rep`` before) rejects it.
+    """
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-promotion JAX
+        from jax.experimental.shard_map import shard_map
+    kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(fn, check_vma=False, **kw)
+    except TypeError:
+        return shard_map(fn, check_rep=False, **kw)
+
+
 def _chunked_forward(q, k, v, mask, block_k, dropout_rate, key):
     """(out, lse) via a lax.scan over K blocks; live tiles O(Sq·block_k).
 
@@ -202,19 +246,9 @@ def _chunked_forward(q, k, v, mask, block_k, dropout_rate, key):
             mb = jax.lax.dynamic_slice_in_dim(mask, i * block_k, block_k,
                                               axis=2)
             s = jnp.where(mb[:, None], s, _NEG_BIG)
-        m_new = jnp.maximum(
-            jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True)), 0.5 * _NEG_BIG
-        )
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        if dropout_rate > 0.0:
-            pm = _dropout_tile(key, i, keep, p.shape)
-            p = jnp.where(pm, p / keep, 0.0)
-        acc_new = alpha * acc + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vb, preferred_element_type=jnp.float32
-        )
-        return (m_new, l_new, acc_new), None
+        drop = (_dropout_tile(key, i, keep, s.shape)
+                if dropout_rate > 0.0 else None)
+        return online_softmax_fold(s, vb, m, l, acc, drop, keep), None
 
     m0 = jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
@@ -410,10 +444,6 @@ def sharded_flash_attention(
     """
     from jax.sharding import PartitionSpec as P
 
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:  # pre-promotion JAX
-        from jax.experimental.shard_map import shard_map
-
     ab = batch_axis if batch_axis in mesh.shape else None
     ah = head_axis if head_axis in mesh.shape else None
     qs = P(ab, None, ah, None)
@@ -429,18 +459,11 @@ def sharded_flash_attention(
             impl=impl, **kwargs,
         )
 
-    kw = dict(
-        mesh=mesh,
+    wrapped = shard_map_nocheck(
+        local, mesh,
         in_specs=(qs, qs, qs, ms if mask is not None else P(), P()),
         out_specs=qs,
     )
-    try:
-        # the scan carries start mesh-invariant and become varying in the
-        # body — sound here (zero-init online-softmax stats), so opt out
-        # of the replication/vma check under whichever name this JAX uses
-        wrapped = shard_map(local, check_vma=False, **kw)
-    except TypeError:
-        wrapped = shard_map(local, check_rep=False, **kw)
     return wrapped(q, k, v, mask, dropout_key)
 
 

@@ -1,0 +1,156 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+Long-context support for the demo-zoo Transformer beyond what fits one
+chip's HBM: shard the sequence over a mesh axis ("sp"), keep each shard's
+queries local, and rotate K/V shards around the ring with
+``jax.lax.ppermute`` while accumulating online-softmax statistics — the
+blockwise-parallel formulation (Liu et al., "Ring Attention with Blockwise
+Transformers"; see PAPERS.md). Peak memory per chip is O(S/sp · d) for
+activations and O(S/sp · S/sp) for score tiles; the full (S, S) logits
+never exist anywhere, and the K/V transfers ride the ICI ring — each hop
+overlaps one neighbor transfer with one local blockwise fold. The last
+fold is peeled out of the scan so no dead final rotation is paid.
+
+Composition with the rest of the stack:
+
+- the per-tile update is :func:`metaopt_tpu.ops.attention
+  .online_softmax_fold` — the same single-source-of-truth fold the chunked
+  scan twin uses, dropout convention included;
+- the collective layer is exactly ``shard_map`` + ``ppermute`` over the
+  trial mesh (SURVEY.md §7's "pick a mesh, annotate shardings, let XLA
+  insert collectives" doctrine) — no bespoke comm backend;
+- autodiff works through ``ppermute`` natively (its transpose is the
+  reverse permute), so the backward is the same ring run in reverse with
+  the blockwise VJP — no custom gradient code needed here.
+
+ref: the reference framework has no model/attention code at all
+(SURVEY.md §5 long-context: "absent by design"); this module is part of
+the TPU-native demo-zoo surface that BASELINE configs exercise, built so
+the framework's flagship workload scales past single-chip sequence
+lengths.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metaopt_tpu.ops.attention import (
+    _NEG_BIG,
+    online_softmax_fold,
+    shard_map_nocheck,
+)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    *,
+    mesh: Mesh,
+    seq_axis: str = "sp",
+    batch_axis: Optional[str] = "dp",
+    head_axis: Optional[str] = "tp",
+    dropout_rate: float = 0.0,
+    dropout_key: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Sequence-parallel attention: Q stays put, K/V ride the ICI ring.
+
+    q: (B, Sq, H, D) pre-scaled by 1/sqrt(D); k, v: (B, Sk, H, D);
+    mask: optional (B, Sq, Sk) bool, True = attend. Sq and Sk must divide
+    by the ``seq_axis`` size (pad upstream). Composes with batch ("dp")
+    and head ("tp") sharding in the same call. Returns (B, Sq, H, D) in
+    q's dtype, sequence-sharded like q.
+
+    Differentiable end-to-end: the ring is a ``lax.scan`` of
+    (local blockwise fold + ``ppermute``) plus one peeled final fold, and
+    every piece transposes cleanly, so ``jax.grad`` yields the
+    reverse-ring backward with blockwise memory — no quadratic logits in
+    either direction.
+    """
+    if seq_axis not in mesh.shape:
+        raise ValueError(f"mesh has no {seq_axis!r} axis: {dict(mesh.shape)}")
+    sp = mesh.shape[seq_axis]
+    if q.shape[1] % sp or k.shape[1] % sp:
+        raise ValueError(
+            f"Sq={q.shape[1]}, Sk={k.shape[1]} must divide seq axis {sp}"
+        )
+    if dropout_rate > 0.0 and dropout_key is None:
+        raise ValueError("dropout_rate > 0 needs a dropout_key")
+    ab = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
+    ah = head_axis if (head_axis and head_axis in mesh.shape) else None
+    qs = P(ab, seq_axis, ah, None)       # (b, s, h, d): sequence-sharded
+    ms = P(ab, seq_axis, None)           # mask: rows with q, cols gathered
+    keep = 1.0 - dropout_rate
+
+    def local(q_loc, k_loc, v_loc, mask_loc, key):
+        # q_loc: (b, sq/sp, h, d); k/v_loc: (b, sk/sp, h, d);
+        # mask_loc: (b, sq/sp, sk) — full key axis, sliced per ring step
+        qt = q_loc.transpose(0, 2, 1, 3).astype(jnp.float32)
+        my = jax.lax.axis_index(seq_axis)
+        sk_loc = k_loc.shape[1]
+        b, h, sq_loc, d = qt.shape
+        if key is not None:
+            # decorrelate the dropout stream per mesh coordinate
+            for ax in (ab, ah, seq_axis):
+                if ax is not None:
+                    key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+
+        def fold(kv, m, l, acc, i):
+            """Fold the currently-held K/V shard (ring position i)."""
+            kt = kv[0].transpose(0, 2, 1, 3).astype(jnp.float32)
+            vt = kv[1].transpose(0, 2, 1, 3).astype(jnp.float32)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                           preferred_element_type=jnp.float32)
+            # position i holds the shard that started (my + i) hops back —
+            # slice the matching key columns from the local mask
+            src = (my - i) % sp
+            if mask_loc is not None:
+                mk = jax.lax.dynamic_slice_in_dim(
+                    mask_loc, src * sk_loc, sk_loc, axis=2
+                )
+                s = jnp.where(mk[:, None], s, _NEG_BIG)
+            drop = None
+            if key is not None:
+                drop = jax.random.bernoulli(
+                    jax.random.fold_in(key, i), keep, s.shape
+                )
+            return online_softmax_fold(s, vt, m, l, acc, drop, keep)
+
+        def step(carry, i):
+            kv, m, l, acc = carry
+            m, l, acc = fold(kv, m, l, acc, i)
+            # rotate K/V one hop around the ring for the next fold
+            kv = jax.tree.map(
+                lambda x: jax.lax.ppermute(
+                    x, seq_axis,
+                    [(j, (j + 1) % sp) for j in range(sp)],
+                ),
+                kv,
+            )
+            return (kv, m, l, acc), None
+
+        m0 = jnp.full((b, h, sq_loc, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((b, h, sq_loc, 1), jnp.float32)
+        acc0 = jnp.zeros((b, h, sq_loc, d), jnp.float32)
+        # sp-1 (fold + rotate) steps in the scan, then the final fold
+        # peeled: the last shard needs no onward rotation, so no dead hop
+        (kv, m, l, acc), _ = jax.lax.scan(
+            step, ((k_loc, v_loc), m0, l0, acc0), jnp.arange(sp - 1)
+        )
+        m, l, acc = fold(kv, m, l, acc, jnp.asarray(sp - 1))
+        out = (acc / jnp.maximum(l, 1e-30)).astype(jnp.float32)
+        # fully-masked rows (l == 0) emit zeros, matching ops.attention
+        out = jnp.where(l > 0, out, 0.0).astype(q_loc.dtype)
+        return out.transpose(0, 2, 1, 3)
+
+    wrapped = shard_map_nocheck(
+        local, mesh,
+        in_specs=(qs, qs, qs, ms if mask is not None else P(), P()),
+        out_specs=qs,
+    )
+    return wrapped(q, k, v, mask, dropout_key)

@@ -79,6 +79,44 @@ class TestSuggest:
         assert s1 == s2
         assert all(p in space for p in s1)
 
+    def test_prefetch_serves_singles_from_one_launch(self):
+        space, tpe = make_tpe(seed=3, pool_prefetch=8)
+        for x in range(-8, 4, 2):
+            tpe.observe([completed(space, {"x": float(x), "c": "a"},
+                                   (x / 5.0) ** 2)])
+        launches = {"n": 0}
+        orig = tpe._launch_ei
+
+        def counting(num):
+            launches["n"] += 1
+            return orig(num)
+
+        tpe._launch_ei = counting
+        singles = [tpe.suggest(1)[0] for _ in range(8)]
+        assert launches["n"] == 1  # one kernel launch served all 8 singles
+        assert all(p in space for p in singles)
+        # observing invalidates the prefetch: next suggest refits
+        tpe.observe([completed(space, {"x": 1.0, "c": "b"}, 0.04)])
+        tpe.suggest(1)
+        assert launches["n"] == 2
+
+    def test_prefetch_survives_state_roundtrip(self):
+        """A restored TPE continues the exact stream: unserved prefetched
+        points are not skipped."""
+        space, tpe = make_tpe(seed=5, pool_prefetch=8)
+        for x in range(-8, 4, 2):
+            tpe.observe([completed(space, {"x": float(x), "c": "a"},
+                                   (x / 5.0) ** 2)])
+        first = tpe.suggest(1)[0]  # launches a batch of 8, serves 1
+        state = tpe.state_dict()
+        live_rest = [tpe.suggest(1)[0] for _ in range(7)]
+
+        _, tpe2 = make_tpe(seed=999, pool_prefetch=8)
+        tpe2.load_state_dict(state)
+        restored_rest = [tpe2.suggest(1)[0] for _ in range(7)]
+        assert restored_rest == live_rest
+        assert first is not None  # stream position 0 was already served
+
     def test_converges_better_than_random(self):
         """On f(x) = (x-3)^2 TPE's best-of-40 should land near 3."""
         space = build_space({"x": "uniform(-10, 10)"})
