@@ -337,12 +337,25 @@ def main() -> None:
 
     on_tpu = jax.default_backend() == "tpu"
     model_stats = {}
-    for name, fn in (("transformer", bench_transformer),
-                     ("resnet", bench_resnet)):
-        try:
-            model_stats.update(fn(on_tpu))
-        except Exception as e:  # a model bench must not sink the TPE metric
-            model_stats[f"{name}_bench_error"] = f"{type(e).__name__}: {e}"
+    for name in ("transformer", "resnet"):
+        # each model bench runs in a child with a deadline: a wedged
+        # remote-compile must degrade to a recorded timeout, not sink the
+        # TPE metric (or hang the driver)
+        env = dict(os.environ)
+        rc, out = run_with_deadline(
+            [sys.executable, os.path.abspath(__file__), "--stage", name],
+            timeout_s=420.0, env=env, capture=True,
+        )
+        if rc == 0:
+            try:
+                model_stats.update(json.loads(out.strip().splitlines()[-1]))
+                continue
+            except (ValueError, IndexError):
+                pass
+        model_stats[f"{name}_bench_error"] = (
+            "stage timeout (compile wedged?)" if rc is None
+            else f"rc={rc}: {out[-200:]}"
+        )
     mosaic = probe_mosaic() if on_tpu else "skipped-cpu"
 
     result = {
@@ -365,5 +378,18 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def stage_main(name: str) -> None:
+    """Child entry: run one model bench, print its stats as one JSON line."""
+    preflight_backend()
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    fn = {"transformer": bench_transformer, "resnet": bench_resnet}[name]
+    print(json.dumps(fn(on_tpu)))
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
+        stage_main(sys.argv[2])
+    else:
+        main()

@@ -6,14 +6,16 @@ configuration / seed_rng`` — is preserved; algorithm *state* is kept
 explicitly serializable (``state_dict`` / ``load_state_dict``) so the
 coordinator can snapshot and observe-replay on restart.
 
-Implementations: Random, GradientDescent (exercises the gradient-result
-protocol), TPE (KDE surrogate + EI as jit/vmap JAX — the north-star hot
-path), Hyperband, ASHA, BOHB (TPE-guided Hyperband), EvolutionES,
-plus the test-support DumbAlgo.
+Implementations: Random, GridSearch (lazy lattice over the UnitCube),
+GradientDescent (exercises the gradient-result protocol), TPE (KDE
+surrogate + EI as jit/vmap JAX — the north-star hot path), Hyperband,
+ASHA, BOHB (TPE-guided Hyperband), EvolutionES, plus the test-support
+DumbAlgo.
 """
 
 from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry, make_algorithm
 from metaopt_tpu.algo.random_search import Random
+from metaopt_tpu.algo.grid_search import GridSearch
 from metaopt_tpu.algo.gradient_descent import GradientDescent
 from metaopt_tpu.algo.tpe import TPE
 from metaopt_tpu.algo.hyperband import Hyperband
@@ -26,6 +28,7 @@ __all__ = [
     "algo_registry",
     "make_algorithm",
     "Random",
+    "GridSearch",
     "GradientDescent",
     "TPE",
     "Hyperband",

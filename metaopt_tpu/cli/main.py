@@ -74,6 +74,15 @@ def build_parser() -> argparse.ArgumentParser:
     ins.add_argument("--params", required=True,
                      help='JSON dict of param values, e.g. \'{"x": 1.5}\'')
 
+    ls = sub.add_parser("list", help="list experiments on the ledger")
+    ls.add_argument("--config", help="framework config YAML")
+    ls.add_argument(
+        "--ledger",
+        help="ledger spec: 'memory', a dir path, 'native:<dir>', "
+             "or coord://host:port",
+    )
+    ls.add_argument("--json", action="store_true", dest="as_json")
+
     st = sub.add_parser("status", help="show experiment state")
     common(st)
     st.add_argument("--json", action="store_true", dest="as_json")
@@ -238,6 +247,34 @@ def _cmd_insert(args, cfg: Dict[str, Any]) -> int:
     return 0
 
 
+def _cmd_list(args, cfg: Dict[str, Any]) -> int:
+    """ref: `orion list` in the lineage — enumerate experiments."""
+    ledger = _make_ledger_from_spec(args.ledger, cfg)
+    rows = []
+    for name in sorted(ledger.list_experiments()):
+        doc = ledger.load_experiment(name) or {}
+        completed = ledger.count(name, "completed")
+        rows.append({
+            "name": name,
+            "algorithm": next(iter(doc.get("algorithm", {})), "?"),
+            "trials": ledger.count(name),
+            "completed": completed,
+            "max_trials": doc.get("max_trials"),
+            "done": bool(doc.get("algo_done"))
+            or completed >= doc.get("max_trials", 0),
+        })
+    if args.as_json:
+        print(json.dumps(rows, indent=2))
+    else:
+        if not rows:
+            print("no experiments")
+        for r in rows:
+            flag = " [done]" if r["done"] else ""
+            print(f"{r['name']}: {r['completed']}/{r['max_trials']} completed "
+                  f"({r['trials']} trials, {r['algorithm']}){flag}")
+    return 0
+
+
 def _cmd_status(args, cfg: Dict[str, Any]) -> int:
     ledger = _make_ledger_from_spec(args.ledger, cfg)
     names = [args.name] if args.name else ledger.list_experiments()
@@ -310,6 +347,7 @@ _COMMANDS = {
     "hunt": _cmd_hunt,
     "init-only": _cmd_init_only,
     "insert": _cmd_insert,
+    "list": _cmd_list,
     "status": _cmd_status,
     "serve": _cmd_serve,
 }

@@ -133,7 +133,12 @@ class Experiment:
         if self.count("completed") >= self.max_trials:
             return True
         doc = self.ledger.load_experiment(self.name)
-        return bool(doc and doc.get("algo_done"))
+        if not (doc and doc.get("algo_done")):
+            return False
+        # the algorithm has nothing more to SUGGEST, but already-registered
+        # trials still deserve execution — an exhausted grid/space must not
+        # strand its queued work
+        return self.count(("new", "reserved")) == 0
 
     def mark_algo_done(self) -> None:
         self.ledger.update_experiment(self.name, {"algo_done": True})

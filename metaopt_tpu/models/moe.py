@@ -1,0 +1,76 @@
+"""Mixture-of-Experts feed-forward with expert parallelism ("ep").
+
+Switch-style top-1 routing (Fedus et al.; see PAPERS.md): a router picks
+one expert per token, tokens are dispatched with a one-hot combine so the
+whole layer stays dense einsums — XLA partitions the expert axis over the
+"ep" mesh dimension (expert weights are sharded E/ep per chip via
+``nn.with_partitioning``) and inserts the dispatch/return collectives
+itself, the GSPMD analogue of the hand-written all_to_all in
+CUDA-era MoE stacks. Inside each expert the hidden dim still splits over
+"tp", so ep composes with the Megatron split.
+
+The router adds the standard switch load-balancing auxiliary loss
+(``n_experts · Σ_e fraction_e · mean_prob_e``), surfaced through the
+module's ``"aux_loss"`` collection so the train step can weigh it in.
+
+ref: the reference framework has no model code (SURVEY.md §2.8) — this is
+demo-zoo surface, here so trials can exercise expert-parallel shardings
+on gang-scheduled sub-slices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MoEFeedForward(nn.Module):
+    d_model: int
+    d_ff: int
+    n_experts: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        b, s, d = x.shape
+        e, f = self.n_experts, self.d_ff
+
+        router = nn.Dense(e, dtype=jnp.float32, name="router")
+        wi = self.param(
+            "wi",
+            nn.with_partitioning(nn.initializers.lecun_normal(),
+                                 ("ep", None, "tp")),
+            (e, d, f),
+        )
+        wo = self.param(
+            "wo",
+            nn.with_partitioning(nn.initializers.lecun_normal(),
+                                 ("ep", "tp", None)),
+            (e, f, d),
+        )
+
+        logits = router(x.astype(jnp.float32))            # (b, s, E)
+        probs = nn.softmax(logits, axis=-1)
+        top = jnp.argmax(probs, axis=-1)                  # (b, s)
+        onehot = jax.nn.one_hot(top, e, dtype=jnp.float32)
+        gate = jnp.sum(probs * onehot, axis=-1)           # (b, s)
+
+        # switch load-balancing loss: fraction of tokens vs mean prob per
+        # expert — pushes the router toward uniform utilization
+        frac = jnp.mean(onehot, axis=(0, 1))              # (E,)
+        mean_prob = jnp.mean(probs, axis=(0, 1))          # (E,)
+        self.sow("aux_loss", "moe_balance",
+                 e * jnp.sum(frac * mean_prob))
+
+        # dense dispatch: (E, b, s, d) masked token copies. Fine at
+        # demo expert counts; GSPMD shards the E axis over "ep" so each
+        # chip materializes only E/ep expert slabs
+        xe = jnp.einsum("bse,bsd->ebsd", onehot, x.astype(jnp.float32))
+        h = nn.relu(jnp.einsum(
+            "ebsd,edf->ebsf", xe.astype(jnp.bfloat16), wi.astype(jnp.bfloat16)
+        ))
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        ye = jnp.einsum("ebsf,efd->ebsd", h, wo.astype(jnp.bfloat16))
+        y = jnp.einsum("ebsd,bse->bsd", ye.astype(jnp.float32), onehot)
+        return (y * gate[..., None]).astype(x.dtype)

@@ -137,11 +137,20 @@ class FeedForward(nn.Module):
         return wo(h)
 
 
+def _make_mlp(d_model, d_ff, dropout, n_experts):
+    if n_experts > 0:
+        from metaopt_tpu.models.moe import MoEFeedForward
+
+        return MoEFeedForward(d_model, d_ff, n_experts, dropout, name="mlp")
+    return FeedForward(d_model, d_ff, dropout, name="mlp")
+
+
 class EncoderLayer(nn.Module):
     d_model: int
     n_heads: int
     d_ff: int
     dropout: float
+    n_experts: int = 0
 
     @nn.compact
     def __call__(self, x, pad_mask, *, train: bool):
@@ -150,9 +159,8 @@ class EncoderLayer(nn.Module):
         x = x + MHA(self.d_model, self.n_heads, self.dropout,
                     name="self_attn")(y, y, pad_mask, train=train)
         y = ln("ln2")(x)
-        x = x + FeedForward(self.d_model, self.d_ff, self.dropout, name="mlp")(
-            y, train=train
-        )
+        x = x + _make_mlp(self.d_model, self.d_ff, self.dropout,
+                          self.n_experts)(y, train=train)
         return x
 
 
@@ -161,6 +169,7 @@ class DecoderLayer(nn.Module):
     n_heads: int
     d_ff: int
     dropout: float
+    n_experts: int = 0
 
     @nn.compact
     def __call__(self, x, enc, causal_mask, cross_mask, *, train: bool):
@@ -172,9 +181,8 @@ class DecoderLayer(nn.Module):
         x = x + MHA(self.d_model, self.n_heads, self.dropout,
                     name="cross_attn")(y, enc, cross_mask, train=train)
         y = ln("ln3")(x)
-        x = x + FeedForward(self.d_model, self.d_ff, self.dropout, name="mlp")(
-            y, train=train
-        )
+        x = x + _make_mlp(self.d_model, self.d_ff, self.dropout,
+                          self.n_experts)(y, train=train)
         return x
 
 
@@ -188,6 +196,9 @@ class Transformer(nn.Module):
     d_ff: int = 2048
     dropout: float = 0.1
     max_len: int = 512
+    #: >0 turns every FFN into a top-1-routed MoE with this many experts
+    #: (weights sharded over the "ep" mesh axis when present)
+    n_experts: int = 0
 
     @nn.compact
     def __call__(self, src, tgt_in, *, train: bool):
@@ -212,13 +223,14 @@ class Transformer(nn.Module):
         x = emb(src) + pos[None, :s_len].astype(jnp.bfloat16)
         for i in range(self.n_layers):
             x = EncoderLayer(self.d_model, self.n_heads, self.d_ff,
-                             self.dropout, name=f"enc{i}")(x, src_pad, train=train)
+                             self.dropout, self.n_experts,
+                             name=f"enc{i}")(x, src_pad, train=train)
         enc = nn.LayerNorm(dtype=jnp.float32, name="enc_ln")(x).astype(jnp.bfloat16)
 
         y = emb(tgt_in) + pos[None, :t_len].astype(jnp.bfloat16)
         for i in range(self.n_layers):
             y = DecoderLayer(self.d_model, self.n_heads, self.d_ff,
-                             self.dropout, name=f"dec{i}")(
+                             self.dropout, self.n_experts, name=f"dec{i}")(
                 y, enc, causal_mask, cross_mask, train=train
             )
         y = nn.LayerNorm(dtype=jnp.float32, name="dec_ln")(y)
@@ -242,20 +254,26 @@ def make_model(hparams: Optional[Dict[str, Any]] = None, **overrides) -> Transfo
         n_layers=int(h.get("n_layers", 6)),
         d_ff=int(h.get("d_ff", 2048)),
         dropout=float(h.get("dropout", 0.1)),
+        n_experts=int(h.get("n_experts", 0)),
     )
 
 
-def loss_fn(model, params, batch, dropout_key):
+def loss_fn(model, params, batch, dropout_key, moe_aux_weight: float = 0.01):
     src, tgt = batch
     bos = jnp.ones((tgt.shape[0], 1), tgt.dtype)
     tgt_in = jnp.concatenate([bos, tgt[:, :-1]], axis=1)
-    logits = model.apply(
+    logits, mutated = model.apply(
         {"params": params}, src, tgt_in, train=True,
         rngs={"dropout": dropout_key},
+        mutable=["aux_loss"],
     )
     loss = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
     mask = (tgt != 0).astype(jnp.float32)
-    return (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    total = (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    aux = jax.tree.leaves(mutated.get("aux_loss", {}))
+    if aux:  # switch load-balancing term from MoE layers
+        total = total + moe_aux_weight * sum(jnp.sum(a) for a in aux)
+    return total
 
 
 def make_train_step(model, tx):
@@ -288,10 +306,30 @@ def init_sharded(
         params = model.init(key, src, src, train=False)["params"]
         return params, tx.init(params)
 
+    def prune(spec):
+        """Drop partition-axis names the mesh doesn't have (→ replicated).
+
+        Model code annotates the FULL parallel surface (tp/ep/...); a
+        trial mesh that only carves out some axes still initializes — the
+        un-carved axes just stay unsharded.
+        """
+        if not isinstance(spec, P):
+            return spec
+        cleaned = []
+        for entry in spec:
+            if entry is None:
+                cleaned.append(None)
+            elif isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a in mesh.axis_names)
+                cleaned.append(kept if kept else None)
+            else:
+                cleaned.append(entry if entry in mesh.axis_names else None)
+        return P(*cleaned)
+
     key = jax.random.PRNGKey(seed)
     shapes = jax.eval_shape(init_fn, key)
     specs = nn.get_partition_spec(shapes)
-    shardings = jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs)
+    shardings = jax.tree.map(lambda sp: NamedSharding(mesh, prune(sp)), specs)
     params, opt_state = jax.jit(init_fn, out_shardings=shardings)(key)
     return params, opt_state, shardings
 

@@ -1,0 +1,101 @@
+"""Pipeline parallelism: a GPipe schedule over a "pp" mesh axis.
+
+Each device along the axis holds ONE stage's parameters (a pytree with a
+leading stage dimension, sharded over "pp"). Microbatches flow stage to
+stage over the ICI ring: every tick, each stage applies its function to
+the activation it holds and passes the result one hop with
+``lax.ppermute``. A batch of M microbatches through P stages takes
+M + P − 1 ticks (the usual GPipe bubble); activations live one microbatch
+per stage, so per-chip activation memory is O(microbatch), not O(batch).
+
+Everything is ``lax.scan`` + ``ppermute`` + one final masked ``psum``, so
+``jax.grad`` differentiates it into the reverse pipeline schedule
+automatically — no bespoke backward.
+
+ref: the reference framework has no parallelism layers at all (SURVEY.md
+§2.8); this is TPU-native demo-zoo surface so trials can shard deep
+stacks across gang-scheduled sub-slices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metaopt_tpu.ops.attention import shard_map_nocheck
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    batch_axis: Optional[str] = "dp",
+    n_microbatches: Optional[int] = None,
+) -> jnp.ndarray:
+    """y = stage_{P-1}(…stage_1(stage_0(x))) with stages sharded over pp.
+
+    ``stage_params``: pytree whose leaves have a leading dimension of size
+    P (one slice per stage), sharded over ``axis``. ``stage_fn(params_p,
+    h) -> h`` must be shape-preserving (same activation shape in and out).
+    ``x``: (B, ...) batch, optionally sharded over ``batch_axis``; the
+    per-shard batch must be a multiple of ``n_microbatches`` (default P).
+    Returns y shaped like x.
+    """
+    if axis not in mesh.shape:
+        raise ValueError(f"mesh has no {axis!r} axis: {dict(mesh.shape)}")
+    n_stages = mesh.shape[axis]
+    ab = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
+    m = n_microbatches or n_stages
+    b_local = x.shape[0] // (mesh.shape[ab] if ab else 1)
+    if b_local % m:
+        raise ValueError(
+            f"per-shard batch {b_local} must be a multiple of "
+            f"n_microbatches {m}"
+        )
+
+    param_specs = jax.tree.map(lambda _: P(axis), stage_params)
+    xs = P(ab, *([None] * (x.ndim - 1)))
+
+    def local(params, x_loc):
+        # params leaves: (1, ...) — this device's stage slice
+        params_p = jax.tree.map(lambda a: a[0], params)
+        p_idx = jax.lax.axis_index(axis)
+        micro = x_loc.reshape(m, x_loc.shape[0] // m, *x_loc.shape[1:])
+        ticks = m + n_stages - 1
+        fwd = [(j, j + 1) for j in range(n_stages - 1)]  # no wraparound
+
+        def tick(carry, t):
+            held = carry  # activation this stage holds entering tick t
+            # stage 0 feeds itself from the microbatch queue (zeros once
+            # the queue is drained — those bubbles are masked out below)
+            feed = jax.lax.dynamic_index_in_dim(
+                micro, jnp.minimum(t, m - 1), keepdims=False
+            ) * (t < m)
+            inp = jnp.where(p_idx == 0, feed, held)
+            out = stage_fn(params_p, inp)
+            # hand the result one hop down the pipe; stage 0 receives
+            # nothing (zeros), the last stage's send is its output
+            nxt = jax.lax.ppermute(out, axis, fwd)
+            return nxt, out
+
+        h0 = jnp.zeros_like(micro[0])
+        _, outs = jax.lax.scan(tick, h0, jnp.arange(ticks))
+        # the last stage emitted microbatch (t - P + 1) at tick t: ticks
+        # P-1 .. P-1+M-1 hold the M results, in order
+        y_loc = jax.lax.dynamic_slice_in_dim(outs, n_stages - 1, m, axis=0)
+        y_loc = y_loc.reshape(x_loc.shape)
+        # only the last stage holds real outputs; broadcast them across
+        # the pp axis so every shard returns the same (replicated) y
+        y_loc = jnp.where(p_idx == n_stages - 1, y_loc, 0.0)
+        return jax.lax.psum(y_loc, axis)
+
+    wrapped = shard_map_nocheck(
+        local, mesh, in_specs=(param_specs, xs), out_specs=xs
+    )
+    return wrapped(stage_params, x)

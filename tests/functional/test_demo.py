@@ -111,6 +111,12 @@ class TestOtherCommands:
         assert stats[0]["trials"] == 1
         assert stats[0]["by_status"] == {"new": 1}
 
+        rc = run_cli(["list", "--ledger", ledger_dir, "--json"])
+        assert rc == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert [r["name"] for r in rows] == ["pre"]
+        assert rows[0]["trials"] == 1 and not rows[0]["done"]
+
     def test_insert_rejects_out_of_space(self, tmp_path, capsys):
         ledger_dir = str(tmp_path / "ledger")
         run_cli(["init-only", "-n", "pre2", "--ledger", ledger_dir,

@@ -1,0 +1,83 @@
+"""Grid search: lattice coverage, log spacing, exhaustion, resume."""
+
+import numpy as np
+import pytest
+
+from metaopt_tpu.algo import GridSearch, make_algorithm
+from metaopt_tpu.space import build_space
+
+
+class TestGrid:
+    def test_covers_full_lattice_once(self):
+        space = build_space({"x": "uniform(0, 10)",
+                             "c": "choices(['a', 'b', 'c'])"})
+        gs = GridSearch(space, n_values=4)
+        pts = gs.suggest(100)
+        assert len(pts) == 4 * 3
+        assert len({tuple(sorted(p.items())) for p in pts}) == 12
+        assert all(p in space for p in pts)
+        assert gs.is_done
+        assert gs.suggest(1) == []
+
+    def test_loguniform_grid_is_log_spaced(self):
+        space = build_space({"lr": "loguniform(1e-4, 1e-1)"})
+        gs = GridSearch(space, n_values=4)
+        xs = sorted(p["lr"] for p in gs.suggest(10))
+        ratios = [xs[i + 1] / xs[i] for i in range(len(xs) - 1)]
+        # log-spaced → constant ratio between neighbors
+        np.testing.assert_allclose(ratios, ratios[0], rtol=1e-6)
+
+    def test_integer_dim_capped_at_cardinality(self):
+        space = build_space({"n": "uniform(1, 3, discrete=True)"})
+        gs = GridSearch(space, n_values=10)
+        pts = gs.suggest(50)
+        assert sorted(p["n"] for p in pts) == [1, 2, 3]
+
+    def test_fidelity_pinned_to_max(self):
+        space = build_space({"x": "uniform(0, 1)",
+                             "epochs": "fidelity(1, 8, base=2)"})
+        gs = GridSearch(space, n_values=3)
+        assert all(p["epochs"] == 8 for p in gs.suggest(5))
+
+    def test_registry_and_state_roundtrip(self):
+        space = build_space({"x": "uniform(0, 10)"})
+        gs = make_algorithm(space, {"grid_search": {"n_values": 5}})
+        first_two = gs.suggest(2)
+        state = gs.state_dict()
+        rest_live = gs.suggest(10)
+
+        gs2 = make_algorithm(space, {"grid_search": {"n_values": 5}})
+        gs2.load_state_dict(state)
+        rest_restored = gs2.suggest(10)
+        assert rest_restored == rest_live
+        assert len(first_two) + len(rest_live) == 5
+
+    def test_exhausted_grid_drains_queued_trials(self):
+        """algo_done must not strand registered-but-unrun trials: a hunt
+        with max_trials above the lattice size still executes every grid
+        point (the is_done contract includes draining the queue)."""
+        from metaopt_tpu.executor import InProcessExecutor
+        from metaopt_tpu.ledger import Experiment
+        from metaopt_tpu.ledger.backends import make_ledger
+        from metaopt_tpu.worker import workon
+
+        exp = Experiment(
+            "grid-drain", make_ledger({"type": "memory"}),
+            space=build_space({"x": "uniform(0, 6)",
+                               "c": "choices(['a', 'b'])"}),
+            max_trials=20, pool_size=5,
+            algorithm={"grid_search": {"n_values": 6}},
+        ).configure()
+        stats = workon(exp, InProcessExecutor(
+            lambda p: (p["x"] - 3) ** 2 + {"a": 0.0, "b": 1.0}[p["c"]]
+        ))
+        assert stats.completed == 12  # the full 6×2 lattice ran
+        assert exp.is_done
+        assert abs(exp.stats["best"]["objective"] - 0.25) < 1e-9
+
+    def test_huge_grid_is_lazy(self):
+        space = build_space({f"x{i}": "uniform(0, 1)" for i in range(8)})
+        gs = GridSearch(space, n_values=50)   # 50^8 ≈ 4e13 points
+        assert gs._total == 50 ** 8
+        pts = gs.suggest(3)                   # no materialization
+        assert len(pts) == 3 and not gs.is_done

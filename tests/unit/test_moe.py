@@ -1,0 +1,104 @@
+"""MoE feed-forward: routing, balance loss, ep sharding, train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metaopt_tpu.models.moe import MoEFeedForward
+from metaopt_tpu.parallel.mesh import make_mesh, use_mesh
+
+
+def init_moe(key, d=16, ff=32, e=4, b=2, s=8):
+    moe = MoEFeedForward(d, ff, e)
+    x = jax.random.normal(key, (b, s, d))
+    variables = moe.init(jax.random.PRNGKey(0), x, train=False)
+    return moe, variables, x
+
+
+class TestMoE:
+    def test_forward_shape_and_finite(self):
+        moe, variables, x = init_moe(jax.random.PRNGKey(1))
+        y = moe.apply(variables, x, train=False)
+        assert y.shape == x.shape
+        assert np.all(np.isfinite(np.asarray(y, np.float32)))
+
+    def test_single_expert_equals_dense_ffn_math(self):
+        """E=1 routes every token to the one expert with gate 1.0 — the
+        layer degenerates to a plain two-matmul FFN."""
+        moe, variables, x = init_moe(jax.random.PRNGKey(2), e=1)
+        y = moe.apply(variables, x, train=False)
+        wi = np.asarray(jax.tree.leaves(
+            variables["params"]["wi"])[0] if hasattr(
+                variables["params"]["wi"], "unbox") else
+            variables["params"]["wi"])
+        # unbox partitioned params
+        from flax import linen as nn
+
+        p = nn.meta.unbox(variables["params"])
+        ref = np.maximum(
+            np.asarray(x, np.float32) @ np.asarray(
+                p["wi"][0], np.float32).astype(np.float32), 0
+        )
+        ref = ref @ np.asarray(p["wo"][0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32), ref, atol=0.15, rtol=0.1
+        )  # bf16 matmuls inside
+
+    def test_balance_loss_sown(self):
+        moe, variables, x = init_moe(jax.random.PRNGKey(3))
+        _, mutated = moe.apply({"params": variables["params"]}, x,
+                               train=False, mutable=["aux_loss"])
+        aux = jax.tree.leaves(mutated["aux_loss"])
+        assert len(aux) == 1
+        # perfectly balanced → 1.0; any routing skew pushes it above
+        assert float(jnp.asarray(aux[0]).reshape(())) >= 1.0 - 1e-6
+
+    def test_ep_sharded_train_step(self):
+        """Transformer with MoE FFNs trains over a dp×tp×ep mesh, expert
+        weights actually laid out over the ep axis."""
+        import optax
+        from flax import linen as nn
+        from jax.sharding import PartitionSpec as P
+
+        from metaopt_tpu.models.transformer import (
+            init_sharded, make_model, make_train_step,
+        )
+        from metaopt_tpu.models.data import synthetic_seq2seq
+        from metaopt_tpu.parallel.sharding import shard_batch
+        from jax.sharding import NamedSharding
+
+        mesh = make_mesh([("dp", 2), ("tp", 2), ("ep", 2)])
+        model = make_model({"d_model": 32, "n_heads": 2, "n_layers": 1,
+                            "d_ff": 64, "vocab": 53, "dropout": 0.1,
+                            "n_experts": 4})
+        tx = optax.adam(1e-3)
+        with use_mesh(mesh):
+            params, opt_state, shardings = init_sharded(model, mesh, tx,
+                                                        (8, 8))
+            wi = nn.meta.unbox(params["enc0"]["mlp"]["wi"])
+            assert wi.sharding.spec == P("ep", None, "tp")
+            step = jax.jit(
+                make_train_step(model, tx),
+                in_shardings=(shardings[0], shardings[1],
+                              NamedSharding(mesh, P("dp")), None),
+                out_shardings=(shardings[0], shardings[1], None),
+                donate_argnums=(0, 1),
+            )
+            src, tgt = synthetic_seq2seq(jax.random.PRNGKey(4), 8, 8, 53)
+            batch = shard_batch(mesh, (src, tgt))
+            params, opt_state, loss = step(params, opt_state, batch,
+                                           jax.random.PRNGKey(5))
+        assert np.isfinite(float(loss)) and float(loss) > 0
+
+    def test_moe_on_eppless_mesh_still_runs(self):
+        """A mesh without an ep axis replicates experts (spec pruning)."""
+        import optax
+
+        from metaopt_tpu.models.transformer import init_sharded, make_model
+
+        mesh = make_mesh([("dp", 4), ("tp", 2)])
+        model = make_model({"d_model": 32, "n_heads": 2, "n_layers": 1,
+                            "d_ff": 64, "vocab": 53, "n_experts": 2})
+        with use_mesh(mesh):
+            params, _, _ = init_sharded(model, mesh, optax.adam(1e-3), (4, 8))
+        assert params is not None
