@@ -341,17 +341,23 @@ def main() -> None:
         # each model bench runs in a child with a deadline: a wedged
         # remote-compile must degrade to a recorded timeout, not sink the
         # TPE metric (or hang the driver)
-        env = dict(os.environ)
         rc, out = run_with_deadline(
             [sys.executable, os.path.abspath(__file__), "--stage", name],
-            timeout_s=420.0, env=env, capture=True,
+            timeout_s=420.0, capture=True,
         )
+        parsed = None
         if rc == 0:
-            try:
-                model_stats.update(json.loads(out.strip().splitlines()[-1]))
-                continue
-            except (ValueError, IndexError):
-                pass
+            # stderr is merged into the capture and runtime teardown may
+            # chatter after the JSON line — scan for the line that parses
+            for line in reversed(out.strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        if isinstance(parsed, dict):
+            model_stats.update(parsed)
+            continue
         model_stats[f"{name}_bench_error"] = (
             "stage timeout (compile wedged?)" if rc is None
             else f"rc={rc}: {out[-200:]}"

@@ -25,6 +25,12 @@ def main():
     p.add_argument("--warmup", type=int, default=400)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--tp", type=int, default=2)
+    p.add_argument("--sp", type=int, default=1,
+                   help=">1 shards the sequence: ring attention over ICI")
+    p.add_argument("--ep", type=int, default=1,
+                   help=">1 carves an expert-parallel mesh axis")
+    p.add_argument("--n-experts", dest="n_experts", type=int, default=0,
+                   help=">0 swaps FFNs for a MoE (shard with --ep)")
     p.add_argument("--steps-per-epoch", type=int, default=50)
     p.add_argument("--d-model", dest="d_model", type=int, default=512,
                    help="model width (smoke runs shrink the base config)")
@@ -37,8 +43,10 @@ def main():
     loss = train_and_eval(
         {"lr": a.lr, "dropout": a.dropout, "warmup": a.warmup,
          "d_model": a.d_model, "n_layers": a.n_layers, "d_ff": a.d_ff,
-         "n_heads": max(1, a.d_model // 64)},
+         "n_heads": max(1, a.d_model // 64), "n_experts": a.n_experts},
         tp=a.tp,
+        sp=a.sp,
+        ep=a.ep,
         steps=a.epochs * a.steps_per_epoch,
     )
     report_results([{"name": "loss", "type": "objective", "value": loss}])

@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
                       help="TPU chips per trial (enables the TPU executor)")
     hunt.add_argument("--timeout-s", type=float, default=None,
                       help="per-trial wall-clock timeout")
+    hunt.add_argument("--warm-start", dest="warm_start", default=None,
+                      help="observe another experiment's completed trials "
+                           "into this experiment's algorithm before "
+                           "suggesting (same ledger)")
     hunt.add_argument("--producer", default=None, choices=["local", "coord"],
                       help="where suggestion runs: 'local' fits the algorithm "
                            "in this worker; 'coord' delegates to the "
@@ -73,6 +77,11 @@ def build_parser() -> argparse.ArgumentParser:
     common(ins)
     ins.add_argument("--params", required=True,
                      help='JSON dict of param values, e.g. \'{"x": 1.5}\'')
+
+    res = sub.add_parser("resume", help="flip suspended trials back to new")
+    common(res)
+    res.add_argument("--trial-id", default=None,
+                     help="resume one trial (default: all suspended)")
 
     ls = sub.add_parser("list", help="list experiments on the ledger")
     ls.add_argument("--config", help="framework config YAML")
@@ -146,6 +155,10 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
                 "no ~priors found in the command; mark searchable args like "
                 "--lr~'loguniform(1e-5, 1e-1)'"
             )
+    metadata = {}
+    warm = getattr(args, "warm_start", None) or cfg.get("warm_start")
+    if warm:
+        metadata["warm_start"] = warm
     exp = Experiment(
         name,
         ledger,
@@ -153,6 +166,7 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
         algorithm=cfg.get("algorithm"),
         max_trials=cfg.get("max_trials", 100),
         pool_size=cfg.get("pool_size", 1),
+        metadata=metadata,
         user_args=user_argv,
     ).configure()
     # a joiner (no cmd) reuses the stored user_args to rebuild the template
@@ -244,6 +258,24 @@ def _cmd_insert(args, cfg: Dict[str, Any]) -> int:
     if not kept:
         raise SystemExit(f"trial already exists: {trial.id}")
     print(f"registered trial {trial.id}")
+    return 0
+
+
+def _cmd_resume(args, cfg: Dict[str, Any]) -> int:
+    """Unpark suspended trials: suspended → new, reservable again."""
+    exp, _ = _experiment_from_args(args, cfg, need_cmd=False)
+    suspended = exp.fetch_trials("suspended")
+    if args.trial_id:
+        suspended = [t for t in suspended if t.id.startswith(args.trial_id)]
+        if not suspended:
+            raise SystemExit(f"no suspended trial matching {args.trial_id!r}")
+    resumed = 0
+    for t in suspended:
+        t.transition("new")
+        t.worker = None
+        if exp.ledger.update_trial(t, expected_status="suspended"):
+            resumed += 1
+    print(f"resumed {resumed} trial(s)")
     return 0
 
 
@@ -348,6 +380,7 @@ _COMMANDS = {
     "init-only": _cmd_init_only,
     "insert": _cmd_insert,
     "list": _cmd_list,
+    "resume": _cmd_resume,
     "status": _cmd_status,
     "serve": _cmd_serve,
 }

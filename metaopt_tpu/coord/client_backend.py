@@ -207,6 +207,12 @@ class CoordLedgerClient(LedgerBackend):
             "judge", experiment=experiment, trial=trial.to_dict(), partial=partial
         )
 
+    def should_suspend(self, experiment: str, trial: Trial) -> bool:
+        """Suspension decision from the hosted algorithm."""
+        return bool(self._call(
+            "should_suspend", experiment=experiment, trial=trial.to_dict()
+        ))
+
     # -- control plane -----------------------------------------------------
     def set_signal(self, experiment: str, trial_id: str, signal: str) -> None:
         """Pod-global control message, e.g. ``"stop"`` to prune a trial."""

@@ -351,7 +351,7 @@ class CoordServer:
         sweep.)
         """
         op = msg.get("op")
-        if op in ("produce", "judge"):
+        if op in ("produce", "judge", "should_suspend"):
             # dispatched OUTSIDE _lock: an algorithm fit (TPE at 10k
             # observations takes seconds) must not stall heartbeats — a
             # blocked heartbeat path lets the stale sweep reclaim LIVE
@@ -375,10 +375,14 @@ class CoordServer:
                             "registered": n,
                             "algo_done": bool(producer.algorithm.is_done),
                         }
-                    else:
+                    elif op == "judge":
                         result = producer.algorithm.judge(
                             Trial.from_dict(a["trial"]), a["partial"]
                         )
+                    else:
+                        result = bool(producer.algorithm.should_suspend(
+                            Trial.from_dict(a["trial"])
+                        ))
                 return {"ok": True, "result": result}
             except Exception as e:
                 return {"ok": False, "error": type(e).__name__, "msg": str(e)}

@@ -77,12 +77,22 @@ class Experiment:
 
         # adopt the stored configuration (reference semantics: joiners defer)
         assert existing is not None
+        requested_meta = dict(self.metadata)
         self.space = build_space(existing["space"])
         self.algorithm = existing["algorithm"]
         self.max_trials = existing.get("max_trials", self.max_trials)
         self.pool_size = existing.get("pool_size", self.pool_size)
         self.metadata = existing.get("metadata", {})
         self.user_args = existing.get("user_args", self.user_args)
+        if (requested_meta.get("warm_start")
+                and "warm_start" not in self.metadata):
+            # a re-attach asking for warm start must not silently lose it:
+            # persist the request into the stored doc so every worker's
+            # producer sees it
+            self.metadata["warm_start"] = requested_meta["warm_start"]
+            self.ledger.update_experiment(
+                self.name, {"metadata": self.metadata}
+            )
         log.info("loaded experiment %r (%d trials on ledger)",
                  self.name, self.ledger.count(self.name))
         self._configured = True

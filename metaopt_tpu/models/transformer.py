@@ -340,6 +340,7 @@ def train_and_eval(
     mesh: Optional[Mesh] = None,
     tp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     n_train: int = 2048,
     batch_size: int = 32,
     seq_len: int = 64,
@@ -349,11 +350,14 @@ def train_and_eval(
     """Train on the synthetic translation task; return final masked loss."""
     from metaopt_tpu.parallel.mesh import trial_mesh, use_mesh
 
-    # sp > 1 shards the sequence axis: attention runs as ring attention
-    # (K/V rotating over ICI), the long-context path
-    mesh = mesh or trial_mesh(
-        tp=tp, extra_axes=(("sp", sp),) if sp > 1 else ()
-    )
+    # sp > 1 shards the sequence axis (ring attention over ICI); ep > 1
+    # carves an expert axis for MoE FFNs (n_experts hparam)
+    extra = []
+    if sp > 1:
+        extra.append(("sp", sp))
+    if ep > 1:
+        extra.append(("ep", ep))
+    mesh = mesh or trial_mesh(tp=tp, extra_axes=tuple(extra))
     model = make_model(hparams)
     lr = float(hparams.get("lr", 1e-3))
     warmup = int(hparams.get("warmup", 10))

@@ -31,6 +31,7 @@ class WorkerStats:
     broken: int = 0
     interrupted: int = 0
     pruned: int = 0
+    suspended: int = 0
     idle_cycles: int = 0
     events: List[Dict[str, Any]] = field(default_factory=list)
     #: producer timing aggregates (observe/suggest latency, SURVEY.md §5)
@@ -76,9 +77,7 @@ def workon(
         return beat
 
     def judge_fn(trial: Trial, partial: List[Dict[str, Any]]):
-        if algo is None:
-            return producer.judge(trial, partial)
-        return algo.judge(trial, partial)
+        return producer.judge(trial, partial)
 
     while not experiment.is_done:
         if worker_trials is not None and stats.reserved >= worker_trials:
@@ -111,6 +110,16 @@ def workon(
 
         stats.idle_cycles = 0
         stats.reserved += 1
+        if producer.should_suspend(trial):
+            # the algorithm wants this trial parked (e.g. a bracket wants
+            # its budget elsewhere first): suspended, not executed;
+            # ``mtpu resume`` flips suspended trials back to new
+            trial.transition("suspended")
+            experiment.ledger.update_trial(
+                trial, expected_status="reserved", expected_worker=worker_id
+            )
+            stats.suspended += 1
+            continue
         log.debug("%s running trial %s %s", worker_id, trial.id[:8], trial.params)
         t0 = time.time()
         try:

@@ -27,11 +27,28 @@ class Producer:
         }
         #: mirrored by RemoteProducer so workon need not touch the algorithm
         self.algo_done = False
+        self._warm_started = False
 
     def produce(self, pool_size: Optional[int] = None) -> int:
         """One observe→suggest→register cycle; returns #trials registered."""
         exp = self.experiment
         t0 = time.perf_counter()
+        if not self._warm_started:
+            # warm start (lineage EVC role): replay another experiment's
+            # completions into the algorithm once, before first suggest —
+            # the surrogate starts informed, trial identity stays local
+            self._warm_started = True
+            src = (exp.metadata or {}).get("warm_start")
+            if src and src != exp.name:
+                fetched = exp.ledger.fetch(src, "completed")
+                usable = [t for t in fetched
+                          if exp.space is None or t.params in exp.space]
+                if usable:
+                    self.algorithm.observe(usable)
+                log.info(
+                    "warm start: observed %d/%d completed trials from %r",
+                    len(usable), len(fetched), src,
+                )
         self.algorithm.observe(exp.fetch_completed_trials())
         self.timings["observe_s"] += time.perf_counter() - t0
         self.timings["cycles"] += 1
@@ -63,6 +80,12 @@ class Producer:
                 len(trials) - len(kept), len(trials),
             )
         return len(kept)
+
+    def judge(self, trial, partial):
+        return self.algorithm.judge(trial, partial)
+
+    def should_suspend(self, trial) -> bool:
+        return self.algorithm.should_suspend(trial)
 
 
 class RemoteProducer:
@@ -106,3 +129,8 @@ class RemoteProducer:
 
     def judge(self, trial, partial):
         return self.experiment.ledger.judge(self.experiment.name, trial, partial)
+
+    def should_suspend(self, trial) -> bool:
+        return bool(self.experiment.ledger.should_suspend(
+            self.experiment.name, trial
+        ))

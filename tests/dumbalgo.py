@@ -23,6 +23,7 @@ class DumbAlgo(BaseAlgorithm):
         scripted: Optional[List[Dict[str, Any]]] = None,
         done_after: Optional[int] = None,
         judge_stop_below: Optional[float] = None,
+        suspend_if: Optional[Dict[str, Any]] = None,
         **config: Any,
     ):
         super().__init__(space, seed=seed, **config)
@@ -30,6 +31,7 @@ class DumbAlgo(BaseAlgorithm):
         self.scripted = list(scripted or [])
         self.done_after = done_after
         self.judge_stop_below = judge_stop_below
+        self.suspend_if = suspend_if
         self.suggest_calls: List[int] = []
         self.observed_trials: List[Trial] = []
 
@@ -54,6 +56,11 @@ class DumbAlgo(BaseAlgorithm):
         if partial[-1]["objective"] < self.judge_stop_below:
             return {"stop": True}
         return None
+
+    def should_suspend(self, trial: Trial) -> bool:
+        if not self.suspend_if:
+            return False
+        return all(trial.params.get(k) == v for k, v in self.suspend_if.items())
 
     @property
     def is_done(self) -> bool:

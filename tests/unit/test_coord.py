@@ -379,6 +379,52 @@ class TestHostedProducer:
         algo = server._producers["tpe-hosted"][0].algorithm
         assert len(done) <= len(algo._observed) + exp.pool_size
 
+    def test_hosted_asha_promotes_rungs(self, server):
+        """Multi-fidelity bookkeeping lives pod-global on the coordinator:
+        three workers drive one hosted ASHA and promotions reach the top
+        rung (the north star's centralized rung table)."""
+        from metaopt_tpu.executor import InProcessExecutor
+        from metaopt_tpu.space import build_space
+        from metaopt_tpu.worker import workon
+
+        c = _client(server)
+        Experiment(
+            "asha-hosted", c,
+            space=build_space({"x": "uniform(0, 1)",
+                               "epochs": "fidelity(1, 4, base=2)"}),
+            max_trials=32, pool_size=2,
+            algorithm={"asha": {"seed": 2, "reduction_factor": 2}},
+        ).configure()
+        errs = []
+
+        def run(i):
+            try:
+                cli = _client(server)
+                e = Experiment("asha-hosted", cli).configure()
+                workon(
+                    e, InProcessExecutor(
+                        lambda p: p["x"] + 1.0 / p["epochs"]
+                    ),
+                    worker_id=f"aw{i}", producer_mode="coord",
+                )
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs
+        done = c.fetch("asha-hosted", "completed")
+        assert len(done) >= 32
+        budgets = {t.params.get("epochs") for t in done}
+        assert max(budgets) >= 2, f"no promotion happened: {budgets}"
+        # the single hosted instance holds the pod-global rung table
+        algo = server._producers["asha-hosted"][0].algorithm
+        table = getattr(algo, "rung_table", None)
+        assert table, "hosted ASHA has no rung occupancy"
+
     def test_hosted_judge_roundtrip(self, server):
         c = _client(server)
         self._exp(c, name="judged", algo={"random": {"seed": 5}})

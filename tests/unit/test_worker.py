@@ -84,6 +84,70 @@ class TestWorkon:
         assert stats.completed == 4          # max_trials counts completions only
         assert exp.count("completed") == 4
 
+    def test_warm_start_observes_foreign_completions_once(self, space):
+        """metadata["warm_start"] replays another experiment's completed
+        trials into the algorithm before the first suggest."""
+        ledger = MemoryLedger()
+        old = Experiment(
+            "old", ledger, space=space, max_trials=3,
+            algorithm={"dumbalgo": {}},
+        ).configure()
+        workon(old, InProcessExecutor(lambda p: p["x"] ** 2), "w-old")
+        assert old.count("completed") == 3
+
+        new = Experiment(
+            "new", ledger, space=space, max_trials=2,
+            algorithm={"dumbalgo": {}},
+            metadata={"warm_start": "old"},
+        ).configure()
+        algo = DumbAlgo(space)
+        prod = Producer(new, algo)
+        prod.produce()
+        foreign = [t for t in algo.observed_trials if t.experiment == "old"]
+        assert len(foreign) == 3
+        prod.produce()  # warm start happens exactly once
+        foreign2 = [t for t in algo.observed_trials if t.experiment == "old"]
+        assert len(foreign2) == 3
+
+    def test_should_suspend_parks_trial_without_executing(self, space):
+        """The algorithm's should_suspend hook: the trial is parked as
+        'suspended', never executed, and doesn't block completion."""
+        exp = Experiment(
+            "susp", MemoryLedger(), space=space, max_trials=4,
+            algorithm={"dumbalgo": {}}, pool_size=1,
+        ).configure()
+        algo = DumbAlgo(
+            space,
+            scripted=[{"x": 9.0}, {"x": 1.0}, {"x": 2.0}, {"x": 3.0}],
+            suspend_if={"x": 9.0},
+            done_after=3,
+        )
+        ran = []
+
+        def objective(p):
+            ran.append(p["x"])
+            return p["x"] ** 2
+
+        stats = workon(exp, InProcessExecutor(objective), "w0",
+                       algorithm=algo, max_idle_cycles=20)
+        assert stats.suspended == 1
+        assert 9.0 not in ran
+        assert stats.completed == 3
+        suspended = exp.fetch_trials("suspended")
+        assert len(suspended) == 1 and suspended[0].params == {"x": 9.0}
+        assert exp.is_done
+
+        # resume path: suspended → new → reservable and executable again
+        t = suspended[0]
+        t.transition("new")
+        t.worker = None
+        assert exp.ledger.update_trial(t, expected_status="suspended")
+        algo2 = DumbAlgo(space, done_after=0)  # suggest nothing new
+        exp2 = Experiment("susp", exp.ledger, max_trials=4).configure()
+        stats2 = workon(exp2, InProcessExecutor(objective), "w1",
+                        algorithm=algo2, max_idle_cycles=10)
+        assert 9.0 in ran and stats2.completed == 1
+
     def test_worker_trials_cap(self, exp):
         stats = workon(
             exp, InProcessExecutor(lambda p: 0.0), "w0", worker_trials=2
