@@ -351,10 +351,12 @@ def main() -> None:
             # chatter after the JSON line — scan for the line that parses
             for line in reversed(out.strip().splitlines()):
                 try:
-                    parsed = json.loads(line)
-                    break
+                    candidate = json.loads(line)
                 except ValueError:
                     continue
+                if isinstance(candidate, dict):  # not a stray scalar line
+                    parsed = candidate
+                    break
         if isinstance(parsed, dict):
             model_stats.update(parsed)
             continue

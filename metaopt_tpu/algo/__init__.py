@@ -9,8 +9,9 @@ coordinator can snapshot and observe-replay on restart.
 Implementations: Random, GridSearch (lazy lattice over the UnitCube),
 GradientDescent (exercises the gradient-result protocol), TPE (KDE
 surrogate + EI as jit/vmap JAX — the north-star hot path), Hyperband,
-ASHA, BOHB (TPE-guided Hyperband), EvolutionES, plus the test-support
-DumbAlgo.
+ASHA, BOHB (TPE-guided Hyperband), EvolutionES, PBT (asynchronous
+population based training with exploit/explore and checkpoint lineage),
+plus the test-support DumbAlgo.
 """
 
 from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry, make_algorithm
@@ -22,6 +23,7 @@ from metaopt_tpu.algo.hyperband import Hyperband
 from metaopt_tpu.algo.asha import ASHA
 from metaopt_tpu.algo.bohb import BOHB
 from metaopt_tpu.algo.evolution_es import EvolutionES
+from metaopt_tpu.algo.pbt import PBT
 
 __all__ = [
     "BaseAlgorithm",
@@ -35,4 +37,5 @@ __all__ = [
     "ASHA",
     "BOHB",
     "EvolutionES",
+    "PBT",
 ]

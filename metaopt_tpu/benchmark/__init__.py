@@ -1,0 +1,37 @@
+"""Benchmark suite: compare algorithms over standard tasks.
+
+ref: the reference lineage's benchmark module (task + assessment +
+benchmark orchestration; post-v0 — SURVEY.md §6 records that the lineage
+grew benchmark *definitions* without published numbers). The five graded
+BASELINE configs live separately in ``benchmarks/run.py``; this package is
+the library API for user-defined algorithm comparisons.
+"""
+
+from metaopt_tpu.benchmark.assessments import (
+    Assessment,
+    AverageRank,
+    AverageResult,
+)
+from metaopt_tpu.benchmark.benchmark import Benchmark, Study
+from metaopt_tpu.benchmark.tasks import (
+    BenchmarkTask,
+    Branin,
+    Rastrigin,
+    RosenBrock,
+    Sphere,
+    task_registry,
+)
+
+__all__ = [
+    "Assessment",
+    "AverageRank",
+    "AverageResult",
+    "Benchmark",
+    "BenchmarkTask",
+    "Branin",
+    "Rastrigin",
+    "RosenBrock",
+    "Sphere",
+    "Study",
+    "task_registry",
+]

@@ -1,0 +1,101 @@
+"""Benchmark assessments: how algorithm comparisons are scored.
+
+ref: the reference lineage's assessment classes (post-v0). An assessment
+consumes the per-repetition regret series the Benchmark collected and
+produces a JSON-able analysis table.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+class Assessment:
+    """Turns {algorithm: [series per repetition]} into an analysis dict.
+
+    A series is the best-so-far objective per completed-trial index (one
+    list per repetition, produced by the Benchmark's runs).
+    """
+
+    #: how many independent repetitions the benchmark should run
+    repetitions: int = 1
+
+    def analyze(
+        self, series: Dict[str, List[List[float]]]
+    ) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.lower()
+
+    @property
+    def configuration(self) -> Dict[str, Any]:
+        return {self.name: {"repetitions": self.repetitions}}
+
+
+def _mean_curves(runs: List[List[float]]) -> List[float]:
+    """Element-wise mean over repetitions, up to the shortest run."""
+    if not runs:
+        return []
+    n = min(len(r) for r in runs)
+    return [sum(r[i] for r in runs) / len(runs) for i in range(n)]
+
+
+class AverageResult(Assessment):
+    """Mean best-so-far objective per trial index, per algorithm."""
+
+    def __init__(self, repetitions: int = 3):
+        self.repetitions = int(repetitions)
+
+    def analyze(self, series):
+        curves = {algo: _mean_curves(runs) for algo, runs in series.items()}
+        final = {
+            algo: (curve[-1] if curve else None)
+            for algo, curve in curves.items()
+        }
+        ranked = sorted(
+            (a for a, v in final.items() if v is not None), key=final.get
+        )
+        return {
+            "assessment": "averageresult",
+            "repetitions": self.repetitions,
+            "curves": curves,
+            "final_best": final,
+            "winner": ranked[0] if ranked else None,
+        }
+
+
+class AverageRank(Assessment):
+    """Mean rank (1 = best) of each algorithm across repetitions.
+
+    Ranks are computed per repetition on the final best objective, so an
+    algorithm that wins most seeds ranks near 1 even if another wins big
+    on one lucky seed.
+    """
+
+    def __init__(self, repetitions: int = 3):
+        self.repetitions = int(repetitions)
+
+    def analyze(self, series):
+        algos = [a for a, runs in series.items() if runs]
+        if not algos:
+            return {"assessment": "averagerank", "ranks": {}, "winner": None}
+        reps = min(len(series[a]) for a in algos)
+        totals = {a: 0.0 for a in algos}
+        for rep in range(reps):
+            finals = {a: series[a][rep][-1] for a in algos if series[a][rep]}
+            order = sorted(finals, key=finals.get)
+            for rank, a in enumerate(order, start=1):
+                totals[a] += rank
+            for a in algos:  # no completed trials this rep = worst rank
+                if a not in finals:
+                    totals[a] += len(algos)
+        ranks = {a: (totals[a] / reps if reps else None) for a in algos}
+        ranked = sorted(ranks, key=ranks.get)
+        return {
+            "assessment": "averagerank",
+            "repetitions": reps,
+            "ranks": ranks,
+            "winner": ranked[0] if ranked else None,
+        }

@@ -1,0 +1,146 @@
+"""The Benchmark orchestrator: algorithms × tasks × assessments.
+
+ref: the reference lineage's benchmark module (post-v0; SURVEY.md §6 notes
+the lineage grew task definitions without published numbers). API shape
+preserved — a benchmark is a named bundle of *studies* (assessment +
+task), processed over a list of algorithm configurations — but execution
+re-uses this framework's own machinery: each (algorithm, task, repetition)
+is a real Experiment on the ledger driven by ``workon`` with the in-process
+executor, so the benchmark exercises exactly the code path users run.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from metaopt_tpu.benchmark.assessments import Assessment
+from metaopt_tpu.benchmark.tasks import BenchmarkTask
+from metaopt_tpu.executor import InProcessExecutor
+from metaopt_tpu.io.webapi import regret_series
+from metaopt_tpu.ledger import Experiment, MemoryLedger
+from metaopt_tpu.ledger.backends import LedgerBackend
+from metaopt_tpu.worker import workon
+
+log = logging.getLogger(__name__)
+
+AlgoSpec = Union[str, Dict[str, Any]]
+
+
+def _algo_config(spec: AlgoSpec) -> Tuple[str, Dict[str, Any]]:
+    if isinstance(spec, str):
+        return spec, {}
+    (name, kwargs), = spec.items()
+    return name, dict(kwargs or {})
+
+
+class Study:
+    """One assessment applied to one task across all algorithms."""
+
+    def __init__(self, assessment: Assessment, task: BenchmarkTask):
+        self.assessment = assessment
+        self.task = task
+        #: algorithm name -> list (one per repetition) of regret series
+        self.series: Dict[str, List[List[float]]] = {}
+
+    def record(self, algo: str, series: List[float]) -> None:
+        self.series.setdefault(algo, []).append(series)
+
+    def analyze(self) -> Dict[str, Any]:
+        return {
+            "task": self.task.name,
+            "task_config": self.task.configuration,
+            **self.assessment.analyze(self.series),
+        }
+
+
+class Benchmark:
+    """Compare algorithms over task/assessment studies.
+
+    >>> bench = Benchmark(
+    ...     "demo",
+    ...     algorithms=["random", {"tpe": {"n_initial": 5}}],
+    ...     targets=[{"assess": [AverageResult(3)], "task": [RosenBrock(25)]}],
+    ... )
+    >>> bench.process()
+    >>> bench.analysis()
+    """
+
+    def __init__(
+        self,
+        name: str,
+        algorithms: Sequence[AlgoSpec],
+        targets: Sequence[Dict[str, Sequence[Any]]],
+        ledger: Optional[LedgerBackend] = None,
+    ):
+        self.name = name
+        self.algorithms = list(algorithms)
+        self.ledger = ledger if ledger is not None else MemoryLedger()
+        self.studies: List[Study] = []
+        for target in targets:
+            for assessment in target["assess"]:
+                for task in target["task"]:
+                    self.studies.append(Study(assessment, task))
+        self._processed = False
+
+    # -- execution ---------------------------------------------------------
+    def _run_one(
+        self, study: Study, algo_name: str, algo_kwargs: Dict[str, Any],
+        repetition: int,
+    ) -> List[float]:
+        from metaopt_tpu.space import build_space
+
+        exp_name = (
+            f"{self.name}-{study.task.name}-{study.assessment.name}-"
+            f"{algo_name}-rep{repetition}"
+        )
+        kwargs = dict(algo_kwargs)
+        kwargs.setdefault("seed", repetition)
+        exp = Experiment(
+            exp_name,
+            self.ledger,
+            space=build_space(study.task.space),
+            algorithm={algo_name: kwargs},
+            max_trials=study.task.max_trials,
+            pool_size=1,
+            metadata={"benchmark": self.name},
+        ).configure()
+        workon(exp, InProcessExecutor(study.task), worker_id=exp_name)
+        return [p["best"] for p in regret_series(self.ledger, exp_name)]
+
+    def process(self) -> None:
+        """Run every (study × algorithm × repetition) experiment."""
+        t0 = time.perf_counter()
+        for study in self.studies:
+            for spec in self.algorithms:
+                algo_name, algo_kwargs = _algo_config(spec)
+                for rep in range(study.assessment.repetitions):
+                    series = self._run_one(study, algo_name, algo_kwargs, rep)
+                    study.record(algo_name, series)
+                    log.info(
+                        "benchmark %s: %s/%s/%s rep %d -> best %s",
+                        self.name, study.task.name, study.assessment.name,
+                        algo_name, rep, series[-1] if series else None,
+                    )
+        self._processed = True
+        log.info("benchmark %s processed in %.1fs",
+                 self.name, time.perf_counter() - t0)
+
+    # -- results -----------------------------------------------------------
+    def analysis(self) -> List[Dict[str, Any]]:
+        if not self._processed:
+            raise RuntimeError("call process() before analysis()")
+        return [s.analyze() for s in self.studies]
+
+    @property
+    def configuration(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "algorithms": self.algorithms,
+            "studies": [
+                {"task": s.task.configuration,
+                 "assessment": s.assessment.configuration}
+                for s in self.studies
+            ],
+        }

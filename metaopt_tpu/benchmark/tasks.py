@@ -1,0 +1,134 @@
+"""Benchmark tasks: standard black-box objectives with declared spaces.
+
+ref: the reference lineage's benchmark task definitions (post-v0; the v0-era
+snapshot has no benchmark module — SURVEY.md §6). Each task is a callable
+objective plus a search-space declaration and a trial budget, so a
+:class:`~metaopt_tpu.benchmark.Benchmark` can run algorithm comparisons
+without any user script. The functions are the classic public test
+objectives (Rosenbrock, Branin, Sphere, Rastrigin).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+from metaopt_tpu.utils.registry import Registry
+
+task_registry: Registry = Registry("benchmark task")
+
+
+class BenchmarkTask:
+    """A self-contained objective: space spec + budget + callable."""
+
+    def __init__(self, max_trials: int = 20):
+        self.max_trials = int(max_trials)
+
+    @property
+    def space(self) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def __call__(self, params: Dict[str, Any]) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.lower()
+
+    @property
+    def configuration(self) -> Dict[str, Any]:
+        return {self.name: {"max_trials": self.max_trials}}
+
+
+def _objective(value: float) -> List[Dict[str, Any]]:
+    return [{"name": "objective", "type": "objective", "value": float(value)}]
+
+
+@task_registry.register("rosenbrock")
+class RosenBrock(BenchmarkTask):
+    """f(x) = Σ 100(x_{i+1} − x_i²)² + (1 − x_i)²; minimum 0 at x=1."""
+
+    def __init__(self, max_trials: int = 30, dim: int = 2):
+        super().__init__(max_trials)
+        self.dim = int(dim)
+
+    @property
+    def space(self) -> Dict[str, str]:
+        return {f"x{i}": "uniform(-5, 10)" for i in range(self.dim)}
+
+    def __call__(self, params):
+        x = [params[f"x{i}"] for i in range(self.dim)]
+        return _objective(sum(
+            100.0 * (x[i + 1] - x[i] ** 2) ** 2 + (1.0 - x[i]) ** 2
+            for i in range(self.dim - 1)
+        ))
+
+    @property
+    def configuration(self):
+        return {self.name: {"max_trials": self.max_trials, "dim": self.dim}}
+
+
+@task_registry.register("branin")
+class Branin(BenchmarkTask):
+    """The 2-D Branin-Hoo function; global minimum ≈ 0.397887."""
+
+    @property
+    def space(self) -> Dict[str, str]:
+        return {"x0": "uniform(-5, 10)", "x1": "uniform(0, 15)"}
+
+    def __call__(self, params):
+        x0, x1 = params["x0"], params["x1"]
+        b = 5.1 / (4 * math.pi ** 2)
+        c = 5.0 / math.pi
+        s = 10.0
+        t = 1.0 / (8 * math.pi)
+        return _objective(
+            (x1 - b * x0 ** 2 + c * x0 - 6.0) ** 2
+            + s * (1 - t) * math.cos(x0) + s
+        )
+
+
+@task_registry.register("sphere")
+class Sphere(BenchmarkTask):
+    """f(x) = Σ x_i²; minimum 0 at the origin."""
+
+    def __init__(self, max_trials: int = 20, dim: int = 2):
+        super().__init__(max_trials)
+        self.dim = int(dim)
+
+    @property
+    def space(self) -> Dict[str, str]:
+        return {f"x{i}": "uniform(-5.12, 5.12)" for i in range(self.dim)}
+
+    def __call__(self, params):
+        return _objective(sum(
+            params[f"x{i}"] ** 2 for i in range(self.dim)
+        ))
+
+    @property
+    def configuration(self):
+        return {self.name: {"max_trials": self.max_trials, "dim": self.dim}}
+
+
+@task_registry.register("rastrigin")
+class Rastrigin(BenchmarkTask):
+    """f(x) = 10d + Σ (x_i² − 10 cos 2πx_i); highly multimodal, min 0."""
+
+    def __init__(self, max_trials: int = 30, dim: int = 2):
+        super().__init__(max_trials)
+        self.dim = int(dim)
+
+    @property
+    def space(self) -> Dict[str, str]:
+        return {f"x{i}": "uniform(-5.12, 5.12)" for i in range(self.dim)}
+
+    def __call__(self, params):
+        return _objective(10.0 * self.dim + sum(
+            params[f"x{i}"] ** 2
+            - 10.0 * math.cos(2 * math.pi * params[f"x{i}"])
+            for i in range(self.dim)
+        ))
+
+    @property
+    def configuration(self):
+        return {self.name: {"max_trials": self.max_trials, "dim": self.dim}}

@@ -58,6 +58,15 @@ def build_parser() -> argparse.ArgumentParser:
                       help="observe another experiment's completed trials "
                            "into this experiment's algorithm before "
                            "suggesting (same ledger)")
+    hunt.add_argument("--branch-from", dest="branch_from", default=None,
+                      help="EVC: create this experiment as a child of "
+                           "another; the parent's completed trials are "
+                           "adapted into the (possibly changed) space and "
+                           "observed before suggesting")
+    hunt.add_argument("--branch-default", dest="branch_default",
+                      action="append", metavar="NAME=VALUE",
+                      help="value backfilled into parent trials for a "
+                           "dimension the child space added (repeatable)")
     hunt.add_argument("--producer", default=None, choices=["local", "coord"],
                       help="where suggestion runs: 'local' fits the algorithm "
                            "in this worker; 'coord' delegates to the "
@@ -71,6 +80,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     init = sub.add_parser("init-only", help="create the experiment and exit")
     common(init)
+    init.add_argument("--branch-from", dest="branch_from", default=None)
+    init.add_argument("--branch-default", dest="branch_default",
+                      action="append", metavar="NAME=VALUE")
     init.add_argument("cmd", nargs=argparse.REMAINDER)
 
     ins = sub.add_parser("insert", help="manually register a trial")
@@ -92,12 +104,33 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ls.add_argument("--json", action="store_true", dest="as_json")
 
+    info = sub.add_parser("info", help="full experiment document + stats")
+    common(info)
+    info.add_argument("--json", action="store_true", dest="as_json")
+
+    plot = sub.add_parser("plot", help="optimization diagnostics")
+    plot.add_argument("kind", choices=["regret"],
+                      help="regret: best-objective-so-far per completed trial")
+    common(plot)
+    plot.add_argument("--json", action="store_true", dest="as_json")
+
     st = sub.add_parser("status", help="show experiment state")
     common(st)
     st.add_argument("--json", action="store_true", dest="as_json")
     st.add_argument("--rungs", action="store_true",
                     help="rung occupancy for multi-fidelity algorithms "
                          "(replays completed trials into the algorithm)")
+
+    web = sub.add_parser(
+        "web", help="read-only REST API over the ledger (dashboards)"
+    )
+    web.add_argument("--config", help="framework config YAML")
+    web.add_argument("--ledger",
+                     help="ledger spec: 'memory', a dir path, 'native:<dir>', "
+                          "or coord://host:port")
+    web.add_argument("--host", default="127.0.0.1")
+    web.add_argument("--port", type=int, default=0,
+                     help="0 binds an ephemeral port (printed at startup)")
 
     srv = sub.add_parser(
         "serve", help="run the pod coordinator (single-writer ledger service)"
@@ -159,6 +192,52 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
     warm = getattr(args, "warm_start", None) or cfg.get("warm_start")
     if warm:
         metadata["warm_start"] = warm
+    version = 1
+    branch = getattr(args, "branch_from", None) or cfg.get("branch_from")
+    if branch:
+        if branch == name:
+            raise SystemExit("--branch-from: the child needs its own name")
+        from metaopt_tpu.ledger.evc import BranchConflictError, TrialAdapter
+        from metaopt_tpu.space import build_space
+
+        parent_doc = ledger.load_experiment(branch)
+        if parent_doc is None:
+            raise SystemExit(f"--branch-from: no such experiment {branch!r}")
+        existing_child = ledger.load_experiment(name)
+        if existing_child is not None:
+            stored = (existing_child.get("metadata") or {}).get("branch") or {}
+            if stored.get("parent") != branch:
+                # configure() adopts stored config, which would silently drop
+                # the requested branch — refuse instead
+                raise SystemExit(
+                    f"experiment {name!r} already exists and was not "
+                    f"branched from {branch!r}; pick a new child name"
+                )
+        parent_space = build_space(parent_doc["space"])
+        defaults: Dict[str, Any] = {}
+        for kv in getattr(args, "branch_default", None) or []:
+            key, sep, raw = kv.partition("=")
+            if not sep:
+                raise SystemExit(
+                    f"--branch-default wants NAME=VALUE, got {kv!r}"
+                )
+            try:
+                defaults[key] = json.loads(raw)
+            except json.JSONDecodeError:
+                defaults[key] = raw
+        if space is None:  # same space, new version (config/code change)
+            space = parent_space
+            user_argv = list(parent_doc.get("user_args", []))
+        try:  # fail at branch time, not at first produce
+            adapter = TrialAdapter(parent_space, space, defaults)
+        except BranchConflictError as err:
+            raise SystemExit(f"cannot branch from {branch!r}: {err}")
+        metadata["branch"] = {
+            "parent": branch,
+            "defaults": defaults,
+            "adapter": adapter.describe(),
+        }
+        version = parent_doc.get("version", 1) + 1
     exp = Experiment(
         name,
         ledger,
@@ -168,6 +247,7 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
         pool_size=cfg.get("pool_size", 1),
         metadata=metadata,
         user_args=user_argv,
+        version=version,
     ).configure()
     # a joiner (no cmd) reuses the stored user_args to rebuild the template
     if template is None and exp.user_args:
@@ -344,6 +424,95 @@ def _cmd_status(args, cfg: Dict[str, Any]) -> int:
     return 0
 
 
+def _cmd_info(args, cfg: Dict[str, Any]) -> int:
+    """ref: `orion info` in the lineage — the full experiment document."""
+    ledger = _make_ledger_from_spec(args.ledger, cfg)
+    if not args.name:
+        raise SystemExit("info needs an experiment name (-n/--name)")
+    doc = ledger.load_experiment(args.name)
+    if doc is None:
+        raise SystemExit(f"no such experiment: {args.name}")
+    exp = Experiment(args.name, ledger).configure()
+    s = exp.stats
+    payload = {
+        "name": exp.name,
+        "version": doc.get("version", 1),
+        "algorithm": exp.algorithm,
+        "space": {n: d.get_prior_string() for n, d in exp.space.items()},
+        "max_trials": exp.max_trials,
+        "pool_size": exp.pool_size,
+        "metadata": exp.metadata,
+        "user_args": exp.user_args,
+        "stats": {"by_status": s["by_status"], "best": s["best"]},
+    }
+    if args.as_json:
+        print(json.dumps(payload, indent=2))
+        return 0
+    print(f"experiment {exp.name} (version {payload['version']})")
+    branch = (exp.metadata or {}).get("branch")
+    if branch:
+        print(f"  branched from: {branch['parent']}")
+    algo_name = next(iter(exp.algorithm), "?")
+    print(f"  algorithm: {algo_name} {exp.algorithm.get(algo_name) or {}}")
+    print("  space:")
+    for n, prior in payload["space"].items():
+        print(f"    {n}~{prior}")
+    print(f"  max_trials: {exp.max_trials}  pool_size: {exp.pool_size}")
+    counts = ", ".join(f"{k}:{v}" for k, v in sorted(s["by_status"].items()))
+    print(f"  trials: {counts or 'none'}")
+    if s["best"]:
+        print(f"  best: {s['best']['objective']:.6g} at {s['best']['params']}")
+    if exp.user_args:
+        print(f"  command: {' '.join(exp.user_args)}")
+    return 0
+
+
+def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
+    """ref: the lineage's regret plot — best-so-far objective per trial.
+
+    Emits JSON (--json) or an ASCII curve; no plotting dependency needed.
+    """
+    from metaopt_tpu.io.webapi import regret_series
+
+    ledger = _make_ledger_from_spec(args.ledger, cfg)
+    if not args.name:
+        raise SystemExit("plot needs an experiment name (-n/--name)")
+    if ledger.load_experiment(args.name) is None:
+        raise SystemExit(f"no such experiment: {args.name}")
+    points = regret_series(ledger, args.name)
+    if args.as_json:
+        print(json.dumps({"experiment": args.name, "regret": points},
+                         indent=2))
+        return 0
+    if not points:
+        print("no completed trials")
+        return 0
+    bests = [p["best"] for p in points]
+    lo, hi = min(bests), max(bests)
+    span = (hi - lo) or 1.0
+    height = 8
+    rows = [[" "] * len(bests) for _ in range(height)]
+    for x, b in enumerate(bests):
+        # row 0 is printed first and labelled `hi`, so b == hi maps to row 0
+        rows[int((hi - b) / span * (height - 1))][x] = "*"
+    print(f"regret ({args.name}): best objective over {len(bests)} "
+          "completed trials")
+    for r, row in enumerate(rows):
+        label = hi - (span * r / (height - 1))
+        print(f"{label:>12.4g} |{''.join(row)}")
+    print(f"{'':>12} +{'-' * len(bests)}")
+    print(f"final best: {bests[-1]:.6g}")
+    return 0
+
+
+def _cmd_web(args, cfg: Dict[str, Any]) -> int:
+    from metaopt_tpu.io.webapi import make_server, serve_forever
+
+    ledger = _make_ledger_from_spec(args.ledger, cfg)
+    serve_forever(make_server(ledger, host=args.host, port=args.port))
+    return 0
+
+
 def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
     from metaopt_tpu.coord.server import CoordServer, serve_forever
 
@@ -379,10 +548,13 @@ _COMMANDS = {
     "hunt": _cmd_hunt,
     "init-only": _cmd_init_only,
     "insert": _cmd_insert,
+    "info": _cmd_info,
     "list": _cmd_list,
+    "plot": _cmd_plot,
     "resume": _cmd_resume,
     "status": _cmd_status,
     "serve": _cmd_serve,
+    "web": _cmd_web,
 }
 
 

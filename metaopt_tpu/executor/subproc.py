@@ -80,6 +80,7 @@ class SubprocessExecutor(Executor):
                 "id": trial.id,
                 "experiment": trial.experiment,
                 "params": trial.params,
+                "parent": trial.parent,
                 "resources": {k: v for k, v in trial.resources.items() if k != "env"},
             }
         )

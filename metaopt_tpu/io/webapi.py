@@ -1,0 +1,155 @@
+"""Read-only REST API over the ledger.
+
+ref: the reference lineage's serving layer (a REST API over experiments and
+trials; post-v0 in the lineage — SURVEY.md §5 records only `status`-style
+observability for the v0 era). Re-based here as a thin stdlib HTTP server
+over the ledger, so dashboards can poll a hunt without touching the
+coordinator's write path:
+
+- ``GET /``                               → route list
+- ``GET /experiments``                    → summaries (mtpu list)
+- ``GET /experiments/{name}``             → full document + stats (mtpu info)
+- ``GET /experiments/{name}/trials``      → trial docs (``?status=`` filter)
+- ``GET /experiments/{name}/regret``      → best-so-far series (mtpu plot)
+- ``GET /healthz``                        → liveness
+
+Deliberately read-only: every write still flows through the single-writer
+coordinator or the flock'd file ledger, so this server can never introduce
+a new race surface.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from metaopt_tpu.ledger.backends import LedgerBackend
+from metaopt_tpu.ledger.trial import STATUSES
+
+log = logging.getLogger(__name__)
+
+
+def _experiment_summary(ledger: LedgerBackend, name: str) -> Dict[str, Any]:
+    doc = ledger.load_experiment(name) or {}
+    completed = ledger.count(name, "completed")
+    return {
+        "name": name,
+        "version": doc.get("version", 1),
+        "algorithm": next(iter(doc.get("algorithm", {})), None),
+        "trials": ledger.count(name),
+        "completed": completed,
+        "max_trials": doc.get("max_trials"),
+        "done": bool(doc.get("algo_done"))
+        or completed >= doc.get("max_trials", float("inf")),
+    }
+
+
+def _experiment_detail(ledger: LedgerBackend, name: str) -> Optional[Dict[str, Any]]:
+    from metaopt_tpu.ledger.experiment import Experiment
+
+    doc = ledger.load_experiment(name)
+    if doc is None:
+        return None
+    s = Experiment(name, ledger).configure().stats
+    return {**doc, "stats": {"by_status": s["by_status"], "best": s["best"]}}
+
+
+def regret_series(ledger: LedgerBackend, name: str) -> List[Dict[str, Any]]:
+    """Best-so-far objective per completed trial (shared with `mtpu plot`)."""
+    done = [t for t in ledger.fetch(name, "completed")
+            if t.objective is not None]
+
+    done.sort(key=lambda t: t.end_time or t.submit_time or 0.0)
+    out, best = [], float("inf")
+    for i, t in enumerate(done):
+        best = min(best, t.objective)
+        out.append({"trial": i, "objective": t.objective, "best": best,
+                    "id": t.id})
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    ledger: LedgerBackend  # set by make_server on the class
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("webapi: " + fmt, *args)
+
+    def _send(self, code: int, payload: Any) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            query = parse_qs(url.query)
+            code, payload = self._route(parts, query)
+        except Exception as err:  # a bad request must not kill the thread
+            log.exception("webapi error for %s", self.path)
+            code, payload = 500, {"error": str(err)}
+        self._send(code, payload)
+
+    def _route(self, parts: List[str], query) -> Tuple[int, Any]:
+        ledger = self.ledger
+        if not parts:
+            return 200, {"routes": [
+                "/experiments", "/experiments/{name}",
+                "/experiments/{name}/trials", "/experiments/{name}/regret",
+                "/healthz",
+            ]}
+        if parts == ["healthz"]:
+            return 200, {"ok": True}
+        if parts[0] != "experiments" or len(parts) > 3:
+            return 404, {"error": f"unknown route /{'/'.join(parts)}"}
+        if len(parts) == 1:
+            return 200, [
+                _experiment_summary(ledger, n)
+                for n in sorted(ledger.list_experiments())
+            ]
+        name = parts[1]
+        if ledger.load_experiment(name) is None:
+            return 404, {"error": f"no such experiment: {name}"}
+        if len(parts) == 2:
+            return 200, _experiment_detail(ledger, name)
+        if parts[2] == "trials":
+            status = (query.get("status") or [None])[0]
+            if status is not None and status not in STATUSES:
+                return 400, {"error": f"status must be one of {STATUSES}"}
+            return 200, [t.to_dict() for t in ledger.fetch(name, status)]
+        if parts[2] == "regret":
+            return 200, {"experiment": name,
+                         "regret": regret_series(ledger, name)}
+        return 404, {"error": f"unknown route /{'/'.join(parts)}"}
+
+
+def make_server(
+    ledger: LedgerBackend, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """A bound (not yet serving) HTTP server; port 0 picks an ephemeral one."""
+    handler = type("BoundHandler", (_Handler,), {"ledger": ledger})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_forever(server: ThreadingHTTPServer) -> None:
+    host, port = server.server_address[:2]
+    print(f"webapi listening on http://{host}:{port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+
+
+def start_in_thread(server: ThreadingHTTPServer) -> threading.Thread:
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t

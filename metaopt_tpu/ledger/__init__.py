@@ -24,6 +24,7 @@ from metaopt_tpu.ledger.backends import (
     ledger_registry,
 )
 from metaopt_tpu.ledger.experiment import Experiment
+from metaopt_tpu.ledger.evc import BranchConflictError, TrialAdapter
 
 __all__ = [
     "Trial",
@@ -33,4 +34,6 @@ __all__ = [
     "DuplicateTrialError",
     "Experiment",
     "ledger_registry",
+    "TrialAdapter",
+    "BranchConflictError",
 ]

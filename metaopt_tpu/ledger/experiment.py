@@ -38,6 +38,7 @@ class Experiment:
         pool_size: int = 1,
         metadata: Optional[Dict[str, Any]] = None,
         user_args: Optional[List[str]] = None,
+        version: int = 1,
     ) -> None:
         self.name = name
         self.ledger = ledger
@@ -47,6 +48,7 @@ class Experiment:
         self.pool_size = pool_size
         self.metadata = metadata or {}
         self.user_args = list(user_args or [])
+        self.version = version
         self._configured = False
 
     # -- configure: create-or-load ---------------------------------------
@@ -65,7 +67,7 @@ class Experiment:
                 "pool_size": self.pool_size,
                 "metadata": {**fetch_metadata(self.user_args), **self.metadata},
                 "user_args": self.user_args,
-                "version": 1,
+                "version": self.version,
             }
             try:
                 self.ledger.create_experiment(doc)
@@ -84,6 +86,7 @@ class Experiment:
         self.pool_size = existing.get("pool_size", self.pool_size)
         self.metadata = existing.get("metadata", {})
         self.user_args = existing.get("user_args", self.user_args)
+        self.version = existing.get("version", 1)
         if (requested_meta.get("warm_start")
                 and "warm_start" not in self.metadata):
             # a re-attach asking for warm start must not silently lose it:

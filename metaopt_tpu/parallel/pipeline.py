@@ -50,13 +50,14 @@ def pipeline_apply(
     if axis not in mesh.shape:
         raise ValueError(f"mesh has no {axis!r} axis: {dict(mesh.shape)}")
     n_stages = mesh.shape[axis]
-    leads = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    leads = {jnp.shape(leaf)[0] if jnp.ndim(leaf) else None
+             for leaf in jax.tree.leaves(stage_params)}
     if leads != {n_stages}:
         # a[0] below keeps exactly one stage per device; any other leading
         # dim would silently drop stages and return wrong numbers
         raise ValueError(
-            f"stage_params leading dims {sorted(leads)} must all equal the "
-            f"{axis} mesh size {n_stages}"
+            f"stage_params leading dims {sorted(leads, key=str)} must all "
+            f"equal the {axis} mesh size {n_stages} (None = scalar leaf)"
         )
     ab = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
     m = n_microbatches or n_stages

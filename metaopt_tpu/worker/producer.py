@@ -38,11 +38,20 @@ class Producer:
             # completions into the algorithm once, before first suggest —
             # the surrogate starts informed, trial identity stays local
             self._warm_started = True
-            src = (exp.metadata or {}).get("warm_start")
-            if src and src != exp.name:
+            meta = exp.metadata or {}
+            branch = meta.get("branch")
+            # both can be set at once: the branch parent replays through the
+            # space adapter, an additional warm-start source through the
+            # plain in-space filter — neither may shadow the other
+            sources = []
+            if branch and branch.get("parent") and branch["parent"] != exp.name:
+                sources.append((branch["parent"], branch))
+            warm = meta.get("warm_start")
+            if warm and warm != exp.name and warm != (branch or {}).get("parent"):
+                sources.append((warm, None))
+            for src, src_branch in sources:
                 fetched = exp.ledger.fetch(src, "completed")
-                usable = [t for t in fetched
-                          if exp.space is None or t.params in exp.space]
+                usable = self._adapt_foreign(fetched, src, src_branch)
                 if usable:
                     self.algorithm.observe(usable)
                 log.info(
@@ -72,7 +81,15 @@ class Producer:
         self.timings["suggested"] += len(points)
         if not points:
             return 0
-        trials = [exp.make_trial(p) for p in points]
+        # PBT-style algorithms mark continuations with the reserved
+        # ``_parent`` key: the trial whose checkpoint the new one resumes
+        trials = [
+            exp.make_trial(
+                {k: v for k, v in p.items() if k != "_parent"},
+                parent=p.get("_parent"),
+            )
+            for p in points
+        ]
         kept = exp.register_trials(trials)
         if len(kept) < len(trials):
             log.debug(
@@ -80,6 +97,27 @@ class Producer:
                 len(trials) - len(kept), len(trials),
             )
         return len(kept)
+
+    def _adapt_foreign(self, fetched, src, branch):
+        """Fit another experiment's trials to this space (EVC branch path)."""
+        exp = self.experiment
+        if branch and exp.space is not None:
+            from metaopt_tpu.ledger.evc import BranchConflictError, TrialAdapter
+            from metaopt_tpu.space import build_space
+
+            parent_doc = exp.ledger.load_experiment(src)
+            if parent_doc is not None:
+                try:
+                    adapter = TrialAdapter(
+                        build_space(parent_doc["space"]),
+                        exp.space,
+                        branch.get("defaults"),
+                    )
+                    return [a for a in map(adapter.adapt, fetched) if a]
+                except BranchConflictError as err:
+                    log.warning("branch adapter rejected: %s; filtering", err)
+        return [t for t in fetched
+                if exp.space is None or t.params in exp.space]
 
     def judge(self, trial, partial):
         return self.algorithm.judge(trial, partial)

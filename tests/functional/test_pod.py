@@ -122,7 +122,9 @@ def _serve_proc(port: int, snap: str) -> None:
 
     serve_forever(CoordServer(
         port=port, snapshot_path=snap, snapshot_interval_s=0.2,
-        stale_timeout_s=4.0, sweep_interval_s=0.5,
+        # wide enough that a CI box under full CPU contention can't starve
+        # a live worker's heartbeat into a spurious stale reclaim
+        stale_timeout_s=10.0, sweep_interval_s=0.5,
     ))
 
 
@@ -147,7 +149,7 @@ def _resilient_worker(port: int, worker_id: str, out_path: str) -> None:
         # outlast the outage + the stale sweep reclaiming orphaned
         # reservations: an idle worker must not give up mid-restart
         max_idle_cycles=600,
-        heartbeat_timeout_s=4.0,
+        heartbeat_timeout_s=10.0,
     )
     with open(out_path, "w") as f:
         json.dump({"completed": stats.completed,

@@ -1,0 +1,100 @@
+"""Benchmark suite tests: tasks, assessments, full process()/analysis()."""
+
+import pytest
+
+from metaopt_tpu.benchmark import (
+    AverageRank,
+    AverageResult,
+    Benchmark,
+    Branin,
+    Rastrigin,
+    RosenBrock,
+    Sphere,
+)
+
+
+class TestTasks:
+    def test_optima(self):
+        r = RosenBrock(dim=3)
+        assert r(dict(x0=1.0, x1=1.0, x2=1.0))[0]["value"] == 0.0
+        s = Sphere(dim=2)
+        assert s(dict(x0=0.0, x1=0.0))[0]["value"] == 0.0
+        ra = Rastrigin(dim=2)
+        assert ra(dict(x0=0.0, x1=0.0))[0]["value"] == pytest.approx(0.0)
+        b = Branin()
+        # one of the three global minima
+        import math
+        assert b(dict(x0=math.pi, x1=2.275))[0]["value"] == pytest.approx(
+            0.397887, abs=1e-4
+        )
+
+    def test_space_specs_build(self):
+        from metaopt_tpu.space import build_space
+        for task in (RosenBrock(dim=2), Branin(), Sphere(), Rastrigin()):
+            space = build_space(task.space)
+            pt = space.sample(1, seed=0)[0]
+            out = task(pt)
+            assert out[0]["type"] == "objective"
+
+
+class TestAssessments:
+    def test_average_result(self):
+        series = {
+            "a": [[3.0, 1.0], [5.0, 3.0]],
+            "b": [[4.0, 2.0], [6.0, 4.0]],
+        }
+        out = AverageResult(2).analyze(series)
+        assert out["curves"]["a"] == [4.0, 2.0]
+        assert out["final_best"] == {"a": 2.0, "b": 3.0}
+        assert out["winner"] == "a"
+
+    def test_average_rank(self):
+        series = {
+            "a": [[1.0], [10.0]],
+            "b": [[2.0], [2.0]],
+        }
+        out = AverageRank(2).analyze(series)
+        assert out["ranks"] == {"a": 1.5, "b": 1.5}
+
+    def test_average_rank_penalizes_empty_reps(self):
+        # an algorithm that completed nothing in a rep must NOT out-rank
+        # one that actually optimized
+        series = {
+            "failed": [[], []],
+            "worked": [[5.0], [5.0]],
+        }
+        out = AverageRank(2).analyze(series)
+        assert out["winner"] == "worked"
+        assert out["ranks"]["failed"] > out["ranks"]["worked"]
+
+
+class TestBenchmark:
+    def test_process_and_analysis(self):
+        bench = Benchmark(
+            "t",
+            algorithms=["random", {"tpe": {"n_initial": 4}}],
+            targets=[{
+                "assess": [AverageResult(2)],
+                "task": [Sphere(max_trials=8)],
+            }],
+        )
+        with pytest.raises(RuntimeError):
+            bench.analysis()
+        bench.process()
+        (study,) = bench.analysis()
+        assert study["task"] == "sphere"
+        assert set(study["curves"]) == {"random", "tpe"}
+        for curve in study["curves"].values():
+            assert len(curve) == 8
+            assert curve == sorted(curve, reverse=True)  # monotone regret
+        assert study["winner"] in ("random", "tpe")
+        # the ledger holds one experiment per (algo, rep)
+        assert len(bench.ledger.list_experiments()) == 4
+
+    def test_configuration_serializable(self):
+        import json
+        bench = Benchmark(
+            "c", ["random"],
+            [{"assess": [AverageRank(1)], "task": [Branin()]}],
+        )
+        json.dumps(bench.configuration)

@@ -1,0 +1,63 @@
+"""`mtpu info` / `mtpu plot regret` tests (lineage: orion info / regret plot)."""
+
+import json
+
+from metaopt_tpu.cli.main import _make_ledger_from_spec, main as cli_main
+from metaopt_tpu.ledger import Experiment
+from metaopt_tpu.space import build_space
+
+
+def seeded_experiment(tmp_path, n=5):
+    led = str(tmp_path / "ledger")
+    ledger = _make_ledger_from_spec(led, {})
+    space = build_space({"x": "uniform(-5, 5)"})
+    exp = Experiment(
+        "seeded", ledger, space=space, max_trials=10,
+        metadata={"branch": {"parent": "origin", "defaults": {}}},
+    ).configure()
+    for i in range(n):
+        t = exp.make_trial({"x": float(i)})
+        exp.register_trials([t])
+        got = exp.reserve_trial("w")
+        exp.push_results(
+            got,
+            [{"name": "o", "type": "objective", "value": float((i - 3) ** 2)}],
+        )
+    return led
+
+
+def test_info_json(tmp_path, capsys):
+    led = seeded_experiment(tmp_path)
+    assert cli_main(["info", "-n", "seeded", "--ledger", led, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["name"] == "seeded"
+    assert doc["space"] == {"x": "uniform(-5, 5)"}
+    assert doc["metadata"]["branch"]["parent"] == "origin"
+    assert doc["stats"]["best"]["objective"] == 0.0
+
+
+def test_info_human(tmp_path, capsys):
+    led = seeded_experiment(tmp_path)
+    assert cli_main(["info", "-n", "seeded", "--ledger", led]) == 0
+    out = capsys.readouterr().out
+    assert "branched from: origin" in out
+    assert "x~uniform(-5, 5)" in out
+
+
+def test_plot_regret_json_monotone(tmp_path, capsys):
+    led = seeded_experiment(tmp_path)
+    assert cli_main(["plot", "regret", "-n", "seeded", "--ledger", led,
+                     "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    bests = [p["best"] for p in doc["regret"]]
+    assert len(bests) == 5
+    assert bests == sorted(bests, reverse=True)  # regret never worsens
+    assert bests[-1] == 0.0
+
+
+def test_plot_regret_ascii(tmp_path, capsys):
+    led = seeded_experiment(tmp_path)
+    assert cli_main(["plot", "regret", "-n", "seeded", "--ledger", led]) == 0
+    out = capsys.readouterr().out
+    assert "final best: 0" in out
+    assert "*" in out

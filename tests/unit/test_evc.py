@@ -1,0 +1,209 @@
+"""EVC tests: trial adaptation across branched spaces, branch warm-start
+
+through the Producer, version bumps, CLI branching end-to-end.
+"""
+
+import json
+
+import pytest
+
+from metaopt_tpu.cli.main import main as cli_main
+from metaopt_tpu.ledger import (
+    BranchConflictError,
+    Experiment,
+    MemoryLedger,
+    Trial,
+    TrialAdapter,
+)
+from metaopt_tpu.space import build_space
+from metaopt_tpu.worker import Producer
+
+from tests.dumbalgo import DumbAlgo
+
+
+def completed(params, objective, space, experiment="parent"):
+    t = Trial(params=dict(params), experiment=experiment)
+    t.id = space.hash_point(params, with_fidelity=True)
+    t.lineage = space.hash_point(params)
+    t.transition("reserved")
+    t.attach_results([{"name": "o", "type": "objective", "value": objective}])
+    t.transition("completed")
+    return t
+
+
+class TestTrialAdapter:
+    def test_identical_space_passes_through(self):
+        parent = build_space({"x": "uniform(-5, 5)"})
+        child = build_space({"x": "uniform(-5, 5)"})
+        ad = TrialAdapter(parent, child)
+        t = completed({"x": 1.5}, 0.1, parent)
+        out = ad.adapt(t)
+        assert out.params == {"x": 1.5}
+        assert out.objective == 0.1
+        assert out.parent == t.id
+        assert ad.describe()["passed"] == ["x"]
+
+    def test_prior_change_filters_out_of_range(self):
+        parent = build_space({"x": "uniform(-5, 5)"})
+        child = build_space({"x": "uniform(0, 1)"})
+        ad = TrialAdapter(parent, child)
+        assert ad.adapt(completed({"x": 0.5}, 0.1, parent)) is not None
+        assert ad.adapt(completed({"x": 3.0}, 0.1, parent)) is None
+        assert ad.describe()["filtered"] == ["x"]
+
+    def test_added_dimension_fills_default(self):
+        parent = build_space({"x": "uniform(-5, 5)"})
+        child = build_space({"x": "uniform(-5, 5)",
+                             "wd": "loguniform(1e-6, 1e-2)"})
+        ad = TrialAdapter(parent, child, {"wd": 1e-4})
+        out = ad.adapt(completed({"x": 1.0}, 0.2, parent))
+        assert out.params == {"x": 1.0, "wd": 1e-4}
+        assert out.lineage == child.hash_point(out.params)
+
+    def test_added_dimension_without_default_conflicts(self):
+        parent = build_space({"x": "uniform(-5, 5)"})
+        child = build_space({"x": "uniform(-5, 5)", "y": "uniform(0, 1)"})
+        with pytest.raises(BranchConflictError):
+            TrialAdapter(parent, child)
+        with pytest.raises(BranchConflictError):  # default out of range
+            TrialAdapter(parent, child, {"y": 7.0})
+        with pytest.raises(BranchConflictError):  # default for unknown dim
+            TrialAdapter(parent, child, {"y": 0.5, "zzz": 1})
+
+    def test_deleted_dimension_strips_value(self):
+        parent = build_space({"x": "uniform(-5, 5)", "old": "uniform(0, 1)"})
+        child = build_space({"x": "uniform(-5, 5)"})
+        ad = TrialAdapter(parent, child)
+        out = ad.adapt(completed({"x": 1.0, "old": 0.3}, 0.2, parent))
+        assert out.params == {"x": 1.0}
+        assert ad.describe()["deleted"] == ["old"]
+
+
+class TestBranchWarmStart:
+    def test_producer_adapts_parent_trials_once(self):
+        ledger = MemoryLedger()
+        parent_space = build_space({"x": "uniform(-5, 5)"})
+        parent = Experiment(
+            "parent", ledger, space=parent_space, max_trials=10,
+        ).configure()
+        for i, x in enumerate([-2.0, 0.5, 4.0]):
+            t = parent.make_trial({"x": x})
+            parent.register_trials([t])
+            got = parent.reserve_trial("w")
+            parent.push_results(
+                got, [{"name": "o", "type": "objective", "value": float(i)}]
+            )
+
+        child_space = build_space({"x": "uniform(0, 5)",
+                                   "wd": "loguniform(1e-6, 1e-2)"})
+        child = Experiment(
+            "child", ledger, space=child_space, max_trials=10,
+            algorithm={"dumbalgo": {}},
+            metadata={"branch": {"parent": "parent",
+                                 "defaults": {"wd": 1e-4}}},
+            version=2,
+        ).configure()
+        algo = DumbAlgo(child_space)
+        prod = Producer(child, algo)
+        prod.produce()
+        # x=-2.0 fell out of the shrunk prior; the other two adapt with wd
+        assert algo.n_observed == 2
+        seen = sorted(t.params["x"] for t in algo.observed_trials)
+        assert seen == [0.5, 4.0]
+        assert all(t.params["wd"] == 1e-4 for t in algo.observed_trials)
+
+
+class TestBranchPlusWarmStart:
+    def test_both_sources_replayed(self):
+        # --branch-from parent --warm-start other: BOTH replay — the branch
+        # parent through the adapter, the warm source through the filter
+        ledger = MemoryLedger()
+        space = build_space({"x": "uniform(-5, 5)"})
+        for name, xs in (("parent", [0.5]), ("other", [1.5, 2.5])):
+            e = Experiment(name, ledger, space=space, max_trials=9).configure()
+            for x in xs:
+                e.register_trials([e.make_trial({"x": x})])
+                got = e.reserve_trial("w")
+                e.push_results(
+                    got, [{"name": "o", "type": "objective", "value": x}]
+                )
+        child = Experiment(
+            "child", ledger,
+            space=build_space({"x": "uniform(-5, 5)",
+                               "wd": "loguniform(1e-6, 1e-2)"}),
+            max_trials=9, algorithm={"dumbalgo": {}},
+            metadata={
+                "branch": {"parent": "parent", "defaults": {"wd": 1e-4}},
+                "warm_start": "other",
+            },
+        ).configure()
+        algo = DumbAlgo(child.space)
+        Producer(child, algo).produce()
+        # parent's trial adapts (wd filled); other's 2 trials lack wd and
+        # fall out of the child space via the plain filter — but they were
+        # FETCHED and considered, not shadowed
+        assert algo.n_observed == 1
+        assert algo.observed_trials[0].params["wd"] == 1e-4
+
+
+class TestCLIBranch:
+    def test_hunt_branch_from_end_to_end(self, tmp_path, capsys):
+        led = str(tmp_path / "ledger")
+        script = tmp_path / "bb.py"
+        script.write_text(
+            "import argparse\n"
+            "from metaopt_tpu import client\n"
+            "p = argparse.ArgumentParser()\n"
+            "p.add_argument('-x', type=float, required=True)\n"
+            "p.add_argument('--seed', type=int, default=0)\n"
+            "a = p.parse_args()\n"
+            "client.report_results([\n"
+            "    {'name': 'o', 'type': 'objective', 'value': (a.x - 1) ** 2}\n"
+            "])\n"
+        )
+        rc = cli_main([
+            "hunt", "-n", "parent", "--ledger", led, "--max-trials", "3",
+            "--", str(script), "-x~uniform(-5, 5)",
+        ])
+        assert rc == 0
+        capsys.readouterr()  # drop the parent hunt's report
+        rc = cli_main([
+            "hunt", "-n", "child", "--ledger", led, "--max-trials", "2",
+            "--branch-from", "parent", "--branch-default", "seed=3",
+            "--", str(script), "-x~uniform(-1, 2)", "--seed~choices([3, 7])",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        payload = json.loads(out[out.index('{'):out.rindex('}') + 1])
+        assert payload["experiment"] == "child"
+
+        # the child document records its lineage and bumped version
+        from metaopt_tpu.cli.main import _make_ledger_from_spec
+        ledger = _make_ledger_from_spec(led, {})
+        doc = ledger.load_experiment("child")
+        assert doc["version"] == 2
+        assert doc["metadata"]["branch"]["parent"] == "parent"
+
+    def test_branch_onto_existing_unbranched_child_refused(self, tmp_path):
+        led = str(tmp_path / "ledger")
+        for name in ("parent", "other"):
+            cli_main([
+                "init-only", "-n", name, "--ledger", led,
+                "--", "x.py", "-x~uniform(0, 1)",
+            ])
+        # 'other' exists and was NOT branched from 'parent' — configure()
+        # would silently adopt its stored config and drop the branch
+        with pytest.raises(SystemExit, match="already exists"):
+            cli_main([
+                "init-only", "-n", "other", "--ledger", led,
+                "--branch-from", "parent",
+                "--", "x.py", "-x~uniform(0, 1)",
+            ])
+
+    def test_branch_from_missing_parent_fails(self, tmp_path):
+        with pytest.raises(SystemExit):
+            cli_main([
+                "init-only", "-n", "child", "--ledger",
+                str(tmp_path / "l"), "--branch-from", "ghost",
+                "--", "x.py", "-x~uniform(0, 1)",
+            ])

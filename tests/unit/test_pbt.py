@@ -1,0 +1,153 @@
+"""PBT unit tests: seeding, async continuation, exploit/explore, parent
+
+links, replay recovery — deterministic seeds, tiny spaces (SURVEY.md §4
+coverage model).
+"""
+
+from metaopt_tpu.algo import PBT, make_algorithm
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space import build_space
+
+
+def make_space():
+    return build_space({
+        "lr": "loguniform(1e-5, 1e-1)",
+        "mom": "uniform(0, 1)",
+        "epochs": "fidelity(1, 8, base=2)",  # rungs 1, 2, 4, 8
+    })
+
+
+def completed(params, objective, space, tid=None):
+    t = Trial(params=dict(params), experiment="e")
+    if tid:
+        t.id = tid
+    t.lineage = space.hash_point(params)
+    t.transition("reserved")
+    t.attach_results([{"name": "o", "type": "objective", "value": objective}])
+    t.transition("completed")
+    return t
+
+
+class TestPBT:
+    def test_registered(self):
+        algo = make_algorithm(make_space(), {"pbt": {"population_size": 4}})
+        assert isinstance(algo, PBT)
+
+    def test_seeds_population_at_base_rung(self):
+        space = make_space()
+        algo = PBT(space, seed=1, population_size=4)
+        pts = algo.suggest(10)
+        assert len(pts) == 4  # exactly the population, nothing more
+        assert all(p["epochs"] == 1 for p in pts)
+        assert all(p in space for p in pts)
+        # nothing to do until results come back
+        assert algo.suggest(1) == []
+
+    def test_continues_member_async_without_barrier(self):
+        space = make_space()
+        algo = PBT(space, seed=2, population_size=4, min_cohort=3)
+        pts = algo.suggest(4)
+        # ONE member finishes; its continuation must come without waiting
+        t = completed(pts[0], 0.5, space, tid="trial-0")
+        algo.observe([t])
+        nxt = algo.suggest(1)
+        assert len(nxt) == 1
+        assert nxt[0]["epochs"] == 2
+        # below min_cohort: continues unchanged, parent = itself
+        assert nxt[0]["_parent"] == "trial-0"
+        assert nxt[0]["lr"] == pts[0]["lr"] and nxt[0]["mom"] == pts[0]["mom"]
+
+    def test_bottom_member_exploits_top(self):
+        space = make_space()
+        algo = PBT(space, seed=3, population_size=4, min_cohort=3,
+                   exploit_quantile=0.25)
+        pts = algo.suggest(4)
+        objs = [0.1, 0.2, 0.3, 9.9]  # member 3 is clearly the loser
+        trials = [
+            completed(p, o, space, tid=f"trial-{i}")
+            for i, (p, o) in enumerate(zip(pts, objs))
+        ]
+        algo.observe(trials)
+        conts = algo.suggest(4)
+        assert len(conts) == 4
+        by_parent = {c["_parent"] for c in conts}
+        # the loser's continuation descends from trial-0 (the top-1 donor),
+        # so trial-3 appears nowhere as a parent
+        assert "trial-3" not in by_parent
+        assert "trial-0" in by_parent
+        # winners continue with their own params
+        keep = [c for c in conts if c["_parent"] == "trial-0"]
+        explored = [c for c in keep
+                    if (c["lr"], c["mom"]) != (pts[0]["lr"], pts[0]["mom"])]
+        # one of trial-0's descendants is the exploit copy: perturbed params
+        assert explored, "exploited continuation must explore (perturb)"
+        for c in conts:
+            assert c["epochs"] == 2
+            assert {k: v for k, v in c.items() if k != "_parent"} in space
+
+    def test_is_done_when_population_tops_out(self):
+        space = make_space()
+        algo = PBT(space, seed=4, population_size=2, min_cohort=2)
+        tid = 0
+        for _round in range(8):
+            if algo.is_done:
+                break
+            pts = algo.suggest(4)
+            trials = []
+            for p in pts:
+                p = {k: v for k, v in p.items() if k != "_parent"}
+                trials.append(completed(p, float(tid), space, tid=f"t{tid}"))
+                tid += 1
+            algo.observe(trials)
+        assert algo.is_done  # both members reached epochs=8
+
+    def test_state_roundtrip_and_replay(self):
+        space = make_space()
+        algo = PBT(space, seed=5, population_size=3, min_cohort=3)
+        pts = algo.suggest(3)
+        trials = [completed(p, float(i), space, tid=f"t{i}")
+                  for i, p in enumerate(pts)]
+        algo.observe(trials)
+        algo.suggest(2)
+        state = algo.state_dict()
+
+        fresh = PBT(space, seed=5, population_size=3, min_cohort=3)
+        fresh.load_state_dict(state)
+        assert fresh._seeded == algo._seeded
+        assert fresh._issued == algo._issued
+        assert fresh._continued == algo._continued
+        # replay path (no state dict): observing completions must not
+        # re-seed the base rung
+        replay = PBT(space, seed=5, population_size=3, min_cohort=3)
+        replay.observe(trials)
+        assert replay._seeded == 3
+        nxt = replay.suggest(5)
+        assert all(p["epochs"] == 2 for p in nxt)  # continuations, not seeds
+
+    def test_exploit_continuation_identical_across_rebuilds(self):
+        # replay safety: a rebuilt instance (coordinator restart) must
+        # regenerate the SAME exploit continuation so ledger dedup absorbs it
+        space = make_space()
+        objs = [0.1, 0.2, 0.3, 9.9]
+
+        def run():
+            algo = PBT(space, seed=3, population_size=4, min_cohort=3,
+                       exploit_quantile=0.25)
+            pts = algo.suggest(4)
+            trials = [completed(p, o, space, tid=f"trial-{i}")
+                      for i, (p, o) in enumerate(zip(pts, objs))]
+            algo.observe(trials)
+            return sorted(
+                (c["_parent"], c["lr"], c["mom"]) for c in algo.suggest(4)
+            )
+
+        assert run() == run()
+
+    def test_rung_table(self):
+        space = make_space()
+        algo = PBT(space, seed=6, population_size=2)
+        pts = algo.suggest(2)
+        algo.observe([completed(pts[0], 0.5, space, tid="a")])
+        table = algo.rung_table
+        assert table[0]["n"] == 1 and table[0]["budget"] == 1
+        assert table[-1]["budget"] == 8

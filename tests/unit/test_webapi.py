@@ -1,0 +1,80 @@
+"""REST webapi tests: routes, filters, 404s — against a live threaded server."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from metaopt_tpu.io.webapi import make_server, start_in_thread
+from metaopt_tpu.ledger import Experiment, MemoryLedger
+from metaopt_tpu.space import build_space
+
+
+@pytest.fixture
+def served():
+    ledger = MemoryLedger()
+    space = build_space({"x": "uniform(-5, 5)"})
+    exp = Experiment("api", ledger, space=space, max_trials=10).configure()
+    for i in range(3):
+        t = exp.make_trial({"x": float(i)})
+        exp.register_trials([t])
+        got = exp.reserve_trial("w")
+        exp.push_results(
+            got, [{"name": "o", "type": "objective", "value": float(2 - i)}]
+        )
+    exp.register_trials([exp.make_trial({"x": 4.5})])  # one 'new' trial
+    server = make_server(ledger)
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    yield f"http://{host}:{port}"
+    server.shutdown()
+    server.server_close()
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_healthz_and_root(served):
+    assert get(f"{served}/healthz") == (200, {"ok": True})
+    status, doc = get(f"{served}/")
+    assert status == 200 and "/experiments" in doc["routes"]
+
+
+def test_experiments_listing_and_detail(served):
+    status, rows = get(f"{served}/experiments")
+    assert status == 200
+    assert rows[0]["name"] == "api"
+    assert rows[0]["completed"] == 3 and rows[0]["trials"] == 4
+
+    status, doc = get(f"{served}/experiments/api")
+    assert status == 200
+    assert doc["max_trials"] == 10
+    assert doc["stats"]["by_status"] == {"completed": 3, "new": 1}
+    assert doc["stats"]["best"]["objective"] == 0.0
+
+
+def test_trials_with_status_filter(served):
+    status, trials = get(f"{served}/experiments/api/trials")
+    assert status == 200 and len(trials) == 4
+    status, trials = get(f"{served}/experiments/api/trials?status=new")
+    assert status == 200 and len(trials) == 1
+    with pytest.raises(urllib.error.HTTPError) as err:
+        get(f"{served}/experiments/api/trials?status=bogus")
+    assert err.value.code == 400
+
+
+def test_regret_series(served):
+    status, doc = get(f"{served}/experiments/api/regret")
+    assert status == 200
+    bests = [p["best"] for p in doc["regret"]]
+    assert bests == [2.0, 1.0, 0.0]
+
+
+def test_unknown_routes_404(served):
+    for path in ("/experiments/ghost", "/nope", "/experiments/api/nope"):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            get(f"{served}{path}")
+        assert err.value.code == 404

@@ -57,6 +57,16 @@ class TestProducer:
         prod.produce()
         assert algo.n_observed == 1
 
+    def test_parent_key_strips_into_trial_lineage(self, exp, space):
+        # PBT continuations carry the reserved _parent key; it must become
+        # Trial.parent, never a param (or a hash ingredient)
+        algo = DumbAlgo(space, value={"x": 2.0, "_parent": "donor-trial"})
+        Producer(exp, algo).produce(pool_size=1)
+        (t,) = exp.fetch_trials()
+        assert t.parent == "donor-trial"
+        assert t.params == {"x": 2.0}
+        assert t.id == space.hash_point({"x": 2.0}, with_fidelity=True)
+
 
 class TestWorkon:
     def test_runs_to_max_trials(self, exp):
