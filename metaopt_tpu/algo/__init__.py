@@ -11,7 +11,8 @@ GradientDescent (exercises the gradient-result protocol), TPE (KDE
 surrogate + EI as jit/vmap JAX — the north-star hot path), Hyperband,
 ASHA, BOHB (TPE-guided Hyperband), EvolutionES, PBT (asynchronous
 population based training with exploit/explore and checkpoint lineage),
-plus the test-support DumbAlgo.
+DEHB (differential evolution over the Hyperband ladder), plus the
+test-support DumbAlgo.
 """
 
 from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry, make_algorithm
@@ -24,6 +25,7 @@ from metaopt_tpu.algo.asha import ASHA
 from metaopt_tpu.algo.bohb import BOHB
 from metaopt_tpu.algo.evolution_es import EvolutionES
 from metaopt_tpu.algo.pbt import PBT
+from metaopt_tpu.algo.dehb import DEHB
 
 __all__ = [
     "BaseAlgorithm",
@@ -38,4 +40,5 @@ __all__ = [
     "BOHB",
     "EvolutionES",
     "PBT",
+    "DEHB",
 ]

@@ -109,8 +109,10 @@ def build_parser() -> argparse.ArgumentParser:
     info.add_argument("--json", action="store_true", dest="as_json")
 
     plot = sub.add_parser("plot", help="optimization diagnostics")
-    plot.add_argument("kind", choices=["regret"],
-                      help="regret: best-objective-so-far per completed trial")
+    plot.add_argument("kind", choices=["regret", "lcurve"],
+                      help="regret: best-objective-so-far per completed "
+                           "trial; lcurve: objective vs fidelity budget per "
+                           "lineage (multi-fidelity experiments)")
     common(plot)
     plot.add_argument("--json", action="store_true", dest="as_json")
 
@@ -120,6 +122,16 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--rungs", action="store_true",
                     help="rung occupancy for multi-fidelity algorithms "
                          "(replays completed trials into the algorithm)")
+
+    db = sub.add_parser("db", help="ledger backend utilities")
+    db.add_argument("action", choices=["test"],
+                    help="test: drive the full backend contract (create, "
+                         "dup-detect, reserve CAS, heartbeat, stale "
+                         "release) against the configured ledger")
+    db.add_argument("--config", help="framework config YAML")
+    db.add_argument("--ledger",
+                    help="ledger spec: 'memory', a dir path, 'native:<dir>', "
+                         "or coord://host:port")
 
     web = sub.add_parser(
         "web", help="read-only REST API over the ledger (dashboards)"
@@ -468,9 +480,9 @@ def _cmd_info(args, cfg: Dict[str, Any]) -> int:
 
 
 def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
-    """ref: the lineage's regret plot — best-so-far objective per trial.
+    """ref: the lineage's regret/lcurve plots.
 
-    Emits JSON (--json) or an ASCII curve; no plotting dependency needed.
+    Emits JSON (--json) or ASCII; no plotting dependency needed.
     """
     from metaopt_tpu.io.webapi import regret_series
 
@@ -479,6 +491,8 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
         raise SystemExit("plot needs an experiment name (-n/--name)")
     if ledger.load_experiment(args.name) is None:
         raise SystemExit(f"no such experiment: {args.name}")
+    if args.kind == "lcurve":
+        return _plot_lcurve(args, ledger)
     points = regret_series(ledger, args.name)
     if args.as_json:
         print(json.dumps({"experiment": args.name, "regret": points},
@@ -503,6 +517,153 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
     print(f"{'':>12} +{'-' * len(bests)}")
     print(f"final best: {bests[-1]:.6g}")
     return 0
+
+
+def _plot_lcurve(args, ledger) -> int:
+    """Objective vs fidelity budget per lineage (ASHA/Hyperband/PBT/DEHB)."""
+    exp = Experiment(args.name, ledger).configure()
+    fid = exp.space.fidelity if exp.space is not None else None
+    if fid is None:
+        raise SystemExit(
+            f"{args.name!r} has no fidelity dimension — lcurve needs a "
+            "multi-fidelity experiment"
+        )
+    curves: Dict[str, List] = {}
+    for t in exp.fetch_completed_trials():
+        if t.objective is None or fid.name not in t.params:
+            continue
+        lineage = t.lineage or exp.space.hash_point(t.params)
+        curves.setdefault(lineage, []).append(
+            {"budget": int(t.params[fid.name]), "objective": t.objective}
+        )
+    for pts in curves.values():
+        pts.sort(key=lambda p: p["budget"])
+    if args.as_json:
+        print(json.dumps({"experiment": args.name, "fidelity": fid.name,
+                          "lcurves": curves}, indent=2))
+        return 0
+    if not curves:
+        print("no completed trials")
+        return 0
+    budgets = sorted({p["budget"] for pts in curves.values() for p in pts})
+    header = "lineage".ljust(14) + "".join(f"{b:>12}" for b in budgets)
+    print(f"learning curves ({args.name}), objective per {fid.name}:")
+    print(header)
+    # deepest-then-best first; cap the table at 20 lineages
+    ranked = sorted(
+        curves.items(),
+        key=lambda kv: (-len(kv[1]), kv[1][-1]["objective"]),
+    )
+    for lineage, pts in ranked[:20]:
+        by_budget = {p["budget"]: p["objective"] for p in pts}
+        cells = "".join(
+            f"{by_budget[b]:>12.4g}" if b in by_budget else " " * 12
+            for b in budgets
+        )
+        print(lineage[:12].ljust(14) + cells)
+    if len(ranked) > 20:
+        print(f"... {len(ranked) - 20} more lineages (use --json for all)")
+    return 0
+
+
+def _cmd_db(args, cfg: Dict[str, Any]) -> int:
+    """ref: the lineage's `db test` — validate a live backend end-to-end.
+
+    Drives the coordination contract against the *configured* ledger (the
+    one production would use), with a throwaway experiment name. Exit 0
+    iff every check passed.
+    """
+    import time as _time
+
+    from metaopt_tpu.ledger.backends import (
+        DuplicateExperimentError,
+        DuplicateTrialError,
+    )
+
+    ledger = _make_ledger_from_spec(args.ledger, cfg)
+    name = f"_dbtest-{os.getpid()}-{int(os.times().elapsed * 1000)}"
+    results: List[tuple] = []
+
+    def check(desc, fn):
+        try:
+            ok = fn()
+            results.append((desc, bool(ok), None))
+        except Exception as err:  # a failing backend must not stop the scan
+            results.append((desc, False, f"{type(err).__name__}: {err}"))
+
+    doc = {"name": name, "space": {"x": "uniform(0, 1)"},
+           "algorithm": {"random": {}}, "max_trials": 1, "version": 1}
+    check("create experiment", lambda: ledger.create_experiment(doc) or True)
+
+    def dup_exp():
+        try:
+            ledger.create_experiment(doc)
+            return False
+        except DuplicateExperimentError:
+            return True
+    check("duplicate experiment rejected", dup_exp)
+    check("load round-trips", lambda: ledger.load_experiment(name)["name"] == name)
+    check("listed", lambda: name in ledger.list_experiments())
+
+    trial = Trial(params={"x": 0.5}, experiment=name)
+    check("register trial", lambda: ledger.register(trial) or True)
+
+    def dup_trial():
+        try:
+            ledger.register(Trial(params={"x": 0.5}, experiment=name,
+                                  id=trial.id))
+            return False
+        except DuplicateTrialError:
+            return True
+    check("duplicate trial rejected", dup_trial)
+
+    got = {}
+    def do_reserve():
+        got["t"] = ledger.reserve(name, "dbtest-w1")
+        return got["t"] is not None and got["t"].id == trial.id
+    check("reserve wins", do_reserve)
+    check("second reserve starves", lambda: ledger.reserve(name, "w2") is None)
+    check("owner heartbeat", lambda: ledger.heartbeat(name, trial.id, "dbtest-w1"))
+    check("foreign heartbeat rejected",
+          lambda: not ledger.heartbeat(name, trial.id, "intruder"))
+
+    def stale_cycle():
+        t = got["t"]
+        t.heartbeat = _time.time() - 10_000
+        ledger.update_trial(t)
+        released = ledger.release_stale(name, 60.0)
+        if not any(r.id == t.id for r in released):
+            return False
+        again = ledger.reserve(name, "dbtest-w2")
+        return again is not None and again.id == t.id
+    check("stale release + re-reserve", stale_cycle)
+
+    def push():
+        t = ledger.get(name, trial.id)
+        t.attach_results([{"name": "o", "type": "objective", "value": 0.25}])
+        t.transition("completed")
+        return ledger.update_trial(
+            t, expected_status="reserved", expected_worker="dbtest-w2"
+        )
+    check("CAS result push", push)
+    check("count by status", lambda: ledger.count(name, "completed") == 1)
+    check("fetch filter",
+          lambda: [t.objective for t in ledger.fetch(name, "completed")] == [0.25])
+
+    try:
+        cleaned = ledger.delete_experiment(name)
+    except Exception:
+        cleaned = False
+    failed = [r for r in results if not r[1]]
+    for desc, ok, err in results:
+        mark = "ok " if ok else "FAIL"
+        print(f"  [{mark}] {desc}" + (f" — {err}" if err else ""))
+    scratch = ("scratch experiment removed" if cleaned
+               else f"scratch experiment {name!r} left on ledger "
+                    "(backend has no delete)")
+    print(f"{len(results) - len(failed)}/{len(results)} checks passed "
+          f"({type(ledger).__name__}; {scratch})")
+    return 0 if not failed else 1
 
 
 def _cmd_web(args, cfg: Dict[str, Any]) -> int:
@@ -548,6 +709,7 @@ _COMMANDS = {
     "hunt": _cmd_hunt,
     "init-only": _cmd_init_only,
     "insert": _cmd_insert,
+    "db": _cmd_db,
     "info": _cmd_info,
     "list": _cmd_list,
     "plot": _cmd_plot,

@@ -98,6 +98,15 @@ class LedgerBackend(ABC):
     def count(self, experiment: str, status: Optional[str | tuple] = None) -> int:
         return len(self.fetch(experiment, status))
 
+    def delete_experiment(self, name: str) -> bool:
+        """Remove an experiment and its trials; False if unsupported.
+
+        Optional: used by housekeeping (`mtpu db test` scratch cleanup).
+        Backends where deletion is unsafe or unimplemented return False and
+        the caller leaves the documents in place.
+        """
+        return False
+
     def release_stale(self, experiment: str, timeout_s: float) -> List[Trial]:
         """Re-free reserved trials whose heartbeat lapsed (dead worker).
 
@@ -155,6 +164,13 @@ class MemoryLedger(LedgerBackend):
     def list_experiments(self) -> List[str]:
         with self._lock:
             return sorted(self._experiments)
+
+    def delete_experiment(self, name: str) -> bool:
+        with self._lock:
+            existed = name in self._experiments
+            self._experiments.pop(name, None)
+            self._trials.pop(name, None)
+            return existed
 
     def register(self, trial: Trial) -> None:
         with self._lock:
@@ -309,6 +325,21 @@ class FileLedger(LedgerBackend):
             if doc and "name" in doc:
                 out.append(doc["name"])
         return sorted(out)
+
+    def delete_experiment(self, name: str) -> bool:
+        import shutil
+
+        with self._locked(name):
+            epath = os.path.join(self._edir(name), "experiment.json")
+            if not os.path.exists(epath):
+                return False
+            # drop the docs under the lock; the directory (with the lock
+            # file inside) goes last, best-effort
+            os.unlink(epath)
+            shutil.rmtree(os.path.join(self._edir(name), "trials"),
+                          ignore_errors=True)
+        shutil.rmtree(self._edir(name), ignore_errors=True)
+        return True
 
     # -- trials -----------------------------------------------------------
     def register(self, trial: Trial) -> None:

@@ -2,6 +2,8 @@
 
 import json
 
+import pytest
+
 from metaopt_tpu.cli.main import _make_ledger_from_spec, main as cli_main
 from metaopt_tpu.ledger import Experiment
 from metaopt_tpu.space import build_space
@@ -61,3 +63,56 @@ def test_plot_regret_ascii(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "final best: 0" in out
     assert "*" in out
+
+
+def seeded_fidelity_experiment(tmp_path):
+    led = str(tmp_path / "fledger")
+    ledger = _make_ledger_from_spec(led, {})
+    space = build_space({"x": "uniform(-5, 5)",
+                         "epochs": "fidelity(1, 4, base=2)"})
+    exp = Experiment("fid", ledger, space=space, max_trials=20).configure()
+    for x in (0.0, 2.0):
+        for budget in (1, 2, 4):
+            t = exp.make_trial({"x": x, "epochs": budget})
+            exp.register_trials([t])
+            got = exp.reserve_trial("w")
+            exp.push_results(
+                got,
+                [{"name": "o", "type": "objective",
+                  "value": (x - 1) ** 2 + 1.0 / budget}],
+            )
+    return led
+
+
+def test_plot_lcurve_json(tmp_path, capsys):
+    led = seeded_fidelity_experiment(tmp_path)
+    assert cli_main(["plot", "lcurve", "-n", "fid", "--ledger", led,
+                     "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["fidelity"] == "epochs"
+    assert len(doc["lcurves"]) == 2  # two lineages
+    for pts in doc["lcurves"].values():
+        assert [p["budget"] for p in pts] == [1, 2, 4]
+        objs = [p["objective"] for p in pts]
+        assert objs == sorted(objs, reverse=True)  # improves with budget
+
+
+def test_db_test_passes_on_file_backend(tmp_path, capsys):
+    rc = cli_main(["db", "test", "--ledger", str(tmp_path / "dbt")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "14/14 checks passed" in out
+    assert "scratch experiment removed" in out
+    # and the ledger really is clean again
+    ledger = _make_ledger_from_spec(str(tmp_path / "dbt"), {})
+    assert ledger.list_experiments() == []
+
+
+def test_plot_lcurve_ascii_and_no_fidelity_error(tmp_path, capsys):
+    led = seeded_fidelity_experiment(tmp_path)
+    assert cli_main(["plot", "lcurve", "-n", "fid", "--ledger", led]) == 0
+    out = capsys.readouterr().out
+    assert "learning curves" in out and "epochs" in out
+    led2 = seeded_experiment(tmp_path)
+    with pytest.raises(SystemExit, match="fidelity"):
+        cli_main(["plot", "lcurve", "-n", "seeded", "--ledger", led2])

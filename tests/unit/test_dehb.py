@@ -1,0 +1,139 @@
+"""DEHB unit tests: bootstrap, DE offspring, promotions, state roundtrip."""
+
+import pytest
+
+from metaopt_tpu.algo import DEHB, make_algorithm
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space import build_space
+
+
+def make_space():
+    return build_space({
+        "lr": "loguniform(1e-5, 1e-1)",
+        "mom": "uniform(0, 1)",
+        "epochs": "fidelity(1, 9, base=3)",  # rungs 1, 3, 9
+    })
+
+
+def completed(params, objective, space, tid=None):
+    t = Trial(params=dict(params), experiment="e")
+    if tid:
+        t.id = tid
+    t.lineage = space.hash_point(params)
+    t.transition("reserved")
+    t.attach_results([{"name": "o", "type": "objective", "value": objective}])
+    t.transition("completed")
+    return t
+
+
+class TestDEHB:
+    def test_registered_and_validated(self):
+        algo = make_algorithm(make_space(), {"dehb": {"population_size": 6}})
+        assert isinstance(algo, DEHB)
+        with pytest.raises(ValueError):
+            DEHB(make_space(), population_size=3)  # DE needs >= 4
+        with pytest.raises(ValueError):
+            DEHB(make_space(), reduction_factor=1)  # eta=1 = promote all
+
+    def test_bootstrap_samples_base_rung(self):
+        space = make_space()
+        algo = DEHB(space, seed=1, population_size=6)
+        pts = algo.suggest(6)
+        assert len(pts) == 6
+        assert all(p["epochs"] == 1 for p in pts)
+        assert all(p in space for p in pts)
+
+    def test_bootstrap_respects_population_size(self):
+        # exactly population_size random members are issued before DE waits
+        # on their results
+        space = make_space()
+        algo = DEHB(space, seed=7, population_size=5)
+        assert len(algo.suggest(20)) == 5
+        assert algo.suggest(1) == []  # in flight; DE needs >= 4 observed
+
+    def test_de_donors_exclude_target(self):
+        # rand/1: with a 4-member pool, F=0 and CR=1 the offspring IS donor
+        # `a`, which must never be the round-robin target
+        space = make_space()
+        algo = DEHB(space, seed=8, population_size=4,
+                    mutation_factor=0.0, crossover_prob=1.0)
+        pop = {
+            f"m{i}": (float(i), [0.1 * (i + 1), 0.1 * (i + 1)])
+            for i in range(4)
+        }
+        for _ in range(40):
+            t_idx = (algo._target_counter + 1) % 4
+            target = sorted(pop.values(), key=lambda m: m[0])[t_idx][1]
+            vec = algo._de_offspring(pop)
+            assert vec != pytest.approx(target)
+
+    def test_offspring_after_population_fills(self):
+        space = make_space()
+        algo = DEHB(space, seed=2, population_size=6)
+        pts = algo.suggest(6)
+        algo.observe([
+            completed(p, float(i), space, tid=f"t{i}")
+            for i, p in enumerate(pts)
+        ])
+        nxt = algo.suggest(4)
+        # promotions come first (6 members / eta=3 -> 2), then DE offspring
+        # evolve the base rung
+        assert len(nxt) == 4
+        assert [p["epochs"] for p in nxt] == [3, 3, 1, 1]
+        assert all(p in space for p in nxt)
+
+    def test_promotion_top_1_over_eta(self):
+        space = make_space()
+        algo = DEHB(space, seed=3, population_size=6, reduction_factor=3)
+        pts = algo.suggest(6)
+        objs = [0.1, 0.5, 0.2, 0.9, 0.3, 0.7]
+        algo.observe([
+            completed(p, o, space, tid=f"t{i}")
+            for i, (p, o) in enumerate(zip(pts, objs))
+        ])
+        nxt = algo.suggest(6)
+        promos = [p for p in nxt if p["epochs"] == 3]
+        assert len(promos) == 2  # 6 members / eta=3
+        # the promoted params are the two best members' params
+        best = sorted(zip(objs, pts))[:2]
+        promoted_lrs = sorted(p["lr"] for p in promos)
+        assert promoted_lrs == sorted(p["lr"] for _, p in best)
+
+    def test_full_ladder_and_state_roundtrip(self):
+        space = make_space()
+        algo = DEHB(space, seed=4, population_size=4, reduction_factor=2)
+        tid = 0
+        for _ in range(6):
+            pts = algo.suggest(8)
+            if not pts:
+                break
+            trials = []
+            for p in pts:
+                trials.append(completed(p, float(tid % 7), space, tid=f"t{tid}"))
+                tid += 1
+            algo.observe(trials)
+        table = algo.rung_table
+        assert table[-1]["budget"] == 9
+        assert table[-1]["n"] > 0  # something reached the top rung
+
+        fresh = DEHB(space, seed=4, population_size=4, reduction_factor=2)
+        fresh.load_state_dict(algo.state_dict())
+        assert fresh._issued == algo._issued
+        assert fresh.rung_table == algo.rung_table
+        assert fresh._target_counter == algo._target_counter
+
+    def test_replay_reconstructs_without_duplicates(self):
+        space = make_space()
+        algo = DEHB(space, seed=5, population_size=4)
+        pts = algo.suggest(4)
+        trials = [completed(p, float(i), space, tid=f"t{i}")
+                  for i, p in enumerate(pts)]
+        algo.observe(trials)
+        replay = DEHB(space, seed=5, population_size=4)
+        replay.observe(trials)
+        # the replayed instance must not re-issue the observed points
+        new = replay.suggest(10)
+        seen = {space.hash_point(p) for p in pts}
+        got = {space.hash_point({k: v for k, v in p.items()})
+               for p in new if p["epochs"] == 1}
+        assert not (seen & got)
