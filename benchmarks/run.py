@@ -107,6 +107,9 @@ CONFIGS = {
     "evolution_ppo": {
         "config": os.path.join(EXAMPLES, "evolution.yaml"),
         "max_trials": {"smoke": 10, "full": 60},
+        # each PPO trial pays a fresh remote Mosaic/XLA compile through the
+        # relay (~2-3 min); 10 smoke trials overran the generic 1800s cap
+        "timeout_scale": 2.0,
         "cmd": [
             os.path.join(EXAMPLES, "ppo_atari.py"),
             "--lr~loguniform(1e-5, 1e-2)",
@@ -246,14 +249,19 @@ def main() -> int:
         print(json.dumps({"warning": "TPU backend unreachable; using CPU"}),
               flush=True)
         backend = "cpu"
-    cap = args.config_timeout_s or (1800.0 if args.scale == "smoke" else 7200.0)
+    # per-config timeout_scale stretches only the DEFAULT cap; an explicit
+    # --config-timeout-s means exactly what the user said
+    explicit_cap = args.config_timeout_s
+    cap = explicit_cap or (1800.0 if args.scale == "smoke" else 7200.0)
 
     results = []
     with tempfile.TemporaryDirectory(prefix="mtpu_bench_") as root:
         for name, spec in CONFIGS.items():
             if args.only and name not in args.only:
                 continue
-            res = run_config(name, spec, args.scale, root, backend, cap)
+            scale = 1.0 if explicit_cap else spec.get("timeout_scale", 1.0)
+            res = run_config(name, spec, args.scale, root, backend,
+                             cap * scale)
             print(json.dumps(res), flush=True)
             results.append(res)
 

@@ -104,10 +104,8 @@ class DEHB(BaseAlgorithm):
             ri = below[-1]
         lineage = trial.lineage or self.space.hash_point(trial.params)
         self._issued.add((lineage, self.budgets[ri]))
-        vec = [float(v) for v in self.cube.transform(
-            {k: v for k, v in trial.params.items()
-             if k != self.fidelity_name}
-        )]
+        # UnitCube.transform reads only non-fidelity dims by name
+        vec = [float(v) for v in self.cube.transform(trial.params)]
         obj = float(trial.objective)
         cur = self._rungs[ri].get(lineage)
         if cur is None or obj < cur[0]:

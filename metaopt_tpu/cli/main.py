@@ -75,6 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
     hunt.add_argument("--profile-dir", default=None,
                       help="capture per-trial jax.profiler traces here "
                            "(scripts opt in with `with client.profiled():`)")
+    hunt.add_argument("--ckpt-root", dest="ckpt_root", default=None,
+                      help="checkpoint root for PBT weight handoff "
+                           "(scripts resolve it via "
+                           "client.checkpoint_paths())")
     hunt.add_argument("cmd", nargs=argparse.REMAINDER,
                       help="user script and its args with ~priors")
 
@@ -290,6 +294,7 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
             interpreter=interpreter,
             timeout_s=args.timeout_s,
             profile_dir=args.profile_dir,
+            ckpt_root=args.ckpt_root or cfg.get("ckpt_root"),
         )
     else:
         executor = SubprocessExecutor(
@@ -298,6 +303,7 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
             interpreter=interpreter,
             timeout_s=args.timeout_s,
             profile_dir=args.profile_dir,
+            ckpt_root=args.ckpt_root or cfg.get("ckpt_root"),
         )
 
     worker_id = args.worker_id or f"{os.uname().nodename}-{os.getpid()}"

@@ -86,6 +86,43 @@ def get_trial_info() -> Optional[Dict[str, Any]]:
     return json.loads(raw) if raw else None
 
 
+CKPT_ROOT_ENV = "METAOPT_TPU_CKPT_ROOT"
+
+
+def checkpoint_paths(root: Optional[str] = None):
+    """(own_dir, parent_dir_or_None) for PBT-style weight handoff.
+
+    PBT continuations carry the donor trial's id in ``Trial.parent``; a
+    script that saves its weights under ``own_dir`` every step and restores
+    from ``parent_dir`` when present inherits the exploited member's
+    training state exactly as the algorithm intends. ``root`` defaults to
+    ``$METAOPT_TPU_CKPT_ROOT`` (injected via ``hunt --ckpt-root``), else a
+    per-experiment directory under the system temp dir. ``parent_dir`` is
+    None when there is no parent or its checkpoint never materialized
+    (broken donor) — scripts must treat that as cold start.
+
+    Usage::
+
+        own, parent = client.checkpoint_paths()
+        if parent: restore(parent)
+        ... train, save(own) ...
+    """
+    import tempfile
+
+    info = get_trial_info() or {}
+    root = root or os.environ.get(CKPT_ROOT_ENV) or os.path.join(
+        tempfile.gettempdir(), "metaopt_tpu_ckpt",
+        str(info.get("experiment") or "standalone"),
+    )
+    own = os.path.join(root, str(info.get("id", os.getpid())))
+    os.makedirs(own, exist_ok=True)
+    parent = info.get("parent")
+    parent_dir = os.path.join(root, str(parent)) if parent else None
+    if parent_dir is not None and not os.path.isdir(parent_dir):
+        parent_dir = None
+    return own, parent_dir
+
+
 PROFILE_DIR_ENV = "METAOPT_TPU_PROFILE_DIR"
 
 
@@ -131,10 +168,12 @@ __all__ = [
     "report_objective",
     "report_partial",
     "get_trial_info",
+    "checkpoint_paths",
     "profiled",
     "IS_ORCHESTRATED",
     "RESULTS_PATH_ENV",
     "TRIAL_INFO_ENV",
     "PROFILE_DIR_ENV",
+    "CKPT_ROOT_ENV",
     "ReportError",
 ]

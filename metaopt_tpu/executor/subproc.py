@@ -43,6 +43,7 @@ class SubprocessExecutor(Executor):
         timeout_s: Optional[float] = None,
         extra_env: Optional[Dict[str, str]] = None,
         profile_dir: Optional[str] = None,
+        ckpt_root: Optional[str] = None,
     ):
         self.template = template
         self.working_dir = working_dir
@@ -53,6 +54,8 @@ class SubprocessExecutor(Executor):
         self.extra_env = dict(extra_env or {})
         if profile_dir:  # opt-in per-trial jax.profiler traces (client.profiled)
             self.extra_env["METAOPT_TPU_PROFILE_DIR"] = profile_dir
+        if ckpt_root:  # PBT weight handoff root (client.checkpoint_paths)
+            self.extra_env["METAOPT_TPU_CKPT_ROOT"] = ckpt_root
 
     # -- env/argv assembly -------------------------------------------------
     def _prepare(self, trial: Trial, tmpdir: str) -> tuple[List[str], Dict[str, str], str]:

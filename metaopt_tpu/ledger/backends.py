@@ -333,12 +333,15 @@ class FileLedger(LedgerBackend):
             epath = os.path.join(self._edir(name), "experiment.json")
             if not os.path.exists(epath):
                 return False
-            # drop the docs under the lock; the directory (with the lock
-            # file inside) goes last, best-effort
+            # drop the DOCS under the lock but keep the directory and its
+            # .lock file: removing the lock file would let a writer blocked
+            # on the old inode and a fresh opener of a recreated .lock hold
+            # "the" lock concurrently. The empty dir is an invisible
+            # tombstone (list_experiments keys on experiment.json) and is
+            # reused as-is if the name is ever recreated.
             os.unlink(epath)
             shutil.rmtree(os.path.join(self._edir(name), "trials"),
                           ignore_errors=True)
-        shutil.rmtree(self._edir(name), ignore_errors=True)
         return True
 
     # -- trials -----------------------------------------------------------

@@ -80,6 +80,15 @@ class NativeFileLedger(FileLedger):
                 self._handles[key] = ent
             return ent
 
+    def delete_experiment(self, name: str) -> bool:
+        """Unsupported: other processes may hold open engine handles whose
+        flock identity a log-file unlink would silently fork (two writers,
+        one believing it has the lock) — the same hazard FileLedger's
+        tombstone delete avoids, but here the open file lives inside the
+        C++ engine where we cannot tombstone. Callers get False and leave
+        the documents in place."""
+        return False
+
     def _take(self, ptr) -> str:
         """Copy + free a malloc'd engine string."""
         if not ptr:

@@ -80,6 +80,39 @@ class TestHuntDemo:
         out = json.loads(capsys.readouterr().out)
         assert out["best"]["objective"] < 25.0  # |x-1| < 5 found by TPE
 
+    def test_pbt_hunt_hands_checkpoints_down_the_ladder(self, tmp_path, capsys):
+        """The shipped PBT example: continuations resume the parent's
+        weights (client.checkpoint_paths), so every above-base-rung trial
+        reports warm=1 and the final loss beats any single cold budget."""
+        ledger_dir = str(tmp_path / "ledger")
+        script = os.path.join(HERE, "..", "..", "examples", "pbt_sgd.py")
+        rc = run_cli([
+            "hunt", "-n", "pbt-demo", "--ledger", ledger_dir,
+            "--max-trials", "20", "--ckpt-root", str(tmp_path / "ckpt"),
+            "--config", self._algo_config(
+                tmp_path,
+                {"pbt": {"population_size": 4, "seed": 3, "min_cohort": 3}},
+            ),
+            os.path.abspath(script),
+            "--lr~loguniform(1e-3, 0.5)", "--steps~fidelity(2, 8, base=2)",
+        ])
+        assert rc == 0
+        capsys.readouterr()
+        from metaopt_tpu.ledger.backends import make_ledger
+
+        exp = Experiment(
+            "pbt-demo", make_ledger({"type": "file", "path": ledger_dir})
+        ).configure()
+        completed = exp.fetch_completed_trials()
+        warm = {
+            t.id: next(r.value for r in t.statistics if r.name == "warm")
+            for t in completed
+        }
+        above_base = [t for t in completed if t.params["steps"] > 2]
+        assert above_base, "PBT never climbed the ladder"
+        assert all(warm[t.id] == 1 for t in above_base)
+        assert all(t.parent for t in above_base)
+
     @staticmethod
     def _algo_config(tmp_path, algo):
         cfg = tmp_path / f"cfg_{list(algo)[0]}.yaml"

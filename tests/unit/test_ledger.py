@@ -167,3 +167,16 @@ class TestRegressionFixes:
         assert ledger.load_experiment("team/run")["name"] == "team/run"
         assert ledger.load_experiment("team_run")["name"] == "team_run"
         assert ledger.list_experiments() == ["team/run", "team_run"]
+
+    def test_delete_experiment_cleans_and_allows_recreate(self, ledger):
+        ledger.create_experiment({"name": "gone"})
+        ledger.register(Trial(params={"x": 1.0}, experiment="gone"))
+        if not ledger.delete_experiment("gone"):
+            pytest.skip("backend has no delete (contract-optional)")
+        assert ledger.load_experiment("gone") is None
+        assert "gone" not in ledger.list_experiments()
+        assert ledger.fetch("gone") == []
+        assert not ledger.delete_experiment("gone")  # idempotent-ish: False
+        # the name is reusable, and old trials don't leak into the new life
+        ledger.create_experiment({"name": "gone"})
+        assert ledger.fetch("gone") == []
