@@ -118,8 +118,14 @@ def checkpoint_paths(root: Optional[str] = None):
     os.makedirs(own, exist_ok=True)
     parent = info.get("parent")
     parent_dir = os.path.join(root, str(parent)) if parent else None
-    if parent_dir is not None and not os.path.isdir(parent_dir):
-        parent_dir = None
+    if parent_dir is not None:
+        # an existing-but-EMPTY dir means the donor called us too and then
+        # died before saving anything — that's a cold start, not a restore
+        try:
+            if not os.listdir(parent_dir):
+                parent_dir = None
+        except OSError:
+            parent_dir = None
     return own, parent_dir
 
 

@@ -275,7 +275,11 @@ class FileLedger(LedgerBackend):
                 fcntl.flock(self.f, fcntl.LOCK_UN)
                 self.f.close()
 
-        return _Lock(os.path.join(self._edir(name), ".lock"))
+        # lock files live OUTSIDE the experiment dir (<root>/.locks/) so
+        # delete_experiment can remove the dir without forking the lock's
+        # identity under a blocked waiter; a lock file is never deleted
+        safe = urllib.parse.quote(name, safe="")
+        return _Lock(os.path.join(self.root, ".locks", safe + ".lock"))
 
     @staticmethod
     def _write_json(path: str, doc: Dict[str, Any]) -> None:
@@ -333,15 +337,10 @@ class FileLedger(LedgerBackend):
             epath = os.path.join(self._edir(name), "experiment.json")
             if not os.path.exists(epath):
                 return False
-            # drop the DOCS under the lock but keep the directory and its
-            # .lock file: removing the lock file would let a writer blocked
-            # on the old inode and a fresh opener of a recreated .lock hold
-            # "the" lock concurrently. The empty dir is an invisible
-            # tombstone (list_experiments keys on experiment.json) and is
-            # reused as-is if the name is ever recreated.
-            os.unlink(epath)
-            shutil.rmtree(os.path.join(self._edir(name), "trials"),
-                          ignore_errors=True)
+            # the flock lives in <root>/.locks/, not in this dir, so
+            # removing the dir cannot fork the lock identity under a
+            # blocked waiter; only the (tiny, reusable) lock file persists
+            shutil.rmtree(self._edir(name), ignore_errors=True)
         return True
 
     # -- trials -----------------------------------------------------------

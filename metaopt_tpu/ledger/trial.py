@@ -16,7 +16,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Mapping, Optional
 
-from metaopt_tpu.utils.hashing import point_hash
+from metaopt_tpu.utils.hashing import jsonable, point_hash
 
 #: Legal status values and transitions.
 STATUSES = ("new", "reserved", "completed", "interrupted", "broken", "suspended")
@@ -74,6 +74,9 @@ class Trial:
     exit_code: Optional[int] = None
 
     def __post_init__(self):
+        # shaped dims sample as numpy arrays: normalize to JSON-native
+        # lists at the boundary so every ledger backend round-trips them
+        self.params = {k: jsonable(v) for k, v in self.params.items()}
         if not self.id:
             self.id = point_hash(self.params)
         if self.submit_time is None:

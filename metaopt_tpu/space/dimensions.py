@@ -95,7 +95,9 @@ class Dimension:
 
     def _each(self, value) -> Iterable[Any]:
         if self.shape:
-            arr = np.asarray(value)
+            # object dtype: mixed-type categorical options (e.g. [1, 'a'])
+            # must not coerce to a common dtype during the check
+            arr = np.asarray(value, dtype=object)
             if arr.shape != self.shape:
                 return iter(())  # wrong shape → nothing to check → not contained
             return arr.flat

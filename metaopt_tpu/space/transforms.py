@@ -20,6 +20,9 @@ Column semantics per dimension type:
                        can treat them specially
 - Fidelity           → excluded (budget is assigned by the algorithm, not
                        searched)
+- array-shaped dims  → one column per element (``w[0, 1]``-style names),
+                       reassembled into arrays on the way back — surrogate
+                       models see a flat cube regardless of param shapes
 """
 
 from __future__ import annotations
@@ -41,13 +44,24 @@ class UnitCube:
 
     def __init__(self, space: Space):
         self.space = space
-        self.dims = [d for d in space.values() if not isinstance(d, Fidelity)]
-        for d in self.dims:
+        #: (dimension, element-index-or-None) per cube column: scalar dims
+        #: own one column, array-shaped dims expand to one column per
+        #: element so surrogate math never sees a ragged structure
+        self.columns = []
+        for d in space.values():
+            if isinstance(d, Fidelity):
+                continue
             if d.shape:
-                raise NotImplementedError(
-                    f"array-shaped dimension {d.name!r} not supported by UnitCube yet"
-                )
-        self.names = [d.name for d in self.dims]
+                for idx in np.ndindex(d.shape):
+                    self.columns.append((d, idx))
+            else:
+                self.columns.append((d, None))
+        #: per-column dimension objects (a shaped dim repeats)
+        self.dims = [d for d, _ in self.columns]
+        self.names = [
+            d.name if idx is None else f"{d.name}{list(idx)}"
+            for d, idx in self.columns
+        ]
         self.categorical_mask = np.asarray(
             [isinstance(d, Categorical) for d in self.dims]
         )
@@ -87,7 +101,24 @@ class UnitCube:
 
     def transform(self, point: Mapping[str, Any]) -> np.ndarray:
         """Point dict → vector in [0,1]^d (fidelity dropped)."""
-        return np.asarray([self._fwd_one(d, point[d.name]) for d in self.dims])
+        out = []
+        arrays: Dict[str, np.ndarray] = {}  # one conversion per shaped dim
+        for d, idx in self.columns:
+            if idx is None:
+                value = point[d.name]
+            else:
+                arr = arrays.get(d.name)
+                if arr is None:
+                    # object dtype for categoricals: mixed-type options
+                    # must not coerce
+                    arr = np.asarray(
+                        point[d.name],
+                        dtype=object if isinstance(d, Categorical) else None,
+                    )
+                    arrays[d.name] = arr
+                value = arr[idx]
+            out.append(self._fwd_one(d, value))
+        return np.asarray(out)
 
     def transform_many(self, points: Sequence[Mapping[str, Any]]) -> np.ndarray:
         if not points:
@@ -118,7 +149,30 @@ class UnitCube:
         vec = np.asarray(vec)
         if vec.shape != (self.n_dims,):
             raise ValueError(f"expected shape ({self.n_dims},), got {vec.shape}")
-        return {d.name: self._bwd_one(d, u) for d, u in zip(self.dims, vec)}
+        out: Dict[str, Any] = {}
+        pending: Dict[str, Dict[tuple, Any]] = {}
+        for (d, idx), u in zip(self.columns, vec):
+            if idx is None:
+                out[d.name] = self._bwd_one(d, u)
+            else:
+                pending.setdefault(d.name, {})[idx] = self._bwd_one(d, u)
+        for (d, idx) in self.columns:  # reassemble shaped dims
+            if idx is None or d.name in out:
+                continue
+            elems = pending[d.name]
+            arr = np.empty(d.shape, dtype=object)
+            for i, v in elems.items():
+                arr[i] = v
+            if isinstance(d, Integer):
+                arr = arr.astype(np.int64)
+            elif isinstance(d, Real):
+                arr = arr.astype(np.float64)
+            else:
+                # Categorical: nested list, NOT np.asarray — mixed-type
+                # options (e.g. [1, 'a']) must not coerce to one dtype
+                arr = arr.tolist()
+            out[d.name] = arr
+        return out
 
     def untransform_many(self, mat: np.ndarray) -> List[Dict[str, Any]]:
         return [self.untransform(row) for row in np.asarray(mat)]

@@ -13,13 +13,34 @@ import math
 from typing import Any, Mapping
 
 
+def jsonable(value: Any) -> Any:
+    """numpy arrays/scalars → plain lists/scalars (for JSON persistence).
+
+    Shaped dimensions sample as ndarrays; trial params must round-trip
+    through the JSON ledgers, so arrays become nested lists at the Trial
+    boundary (containment/transforms accept lists transparently).
+    """
+    if isinstance(value, (str, bytes)):
+        return value
+    if hasattr(value, "tolist"):  # ndarray and numpy scalars alike
+        return value.tolist()
+    if isinstance(value, (list, tuple)):
+        return [jsonable(v) for v in value]
+    if isinstance(value, Mapping):
+        return {str(k): jsonable(v) for k, v in value.items()}
+    return value
+
+
 def _canon(value: Any) -> Any:
     """Canonicalize values so that e.g. numpy scalars and Python scalars agree."""
-    if hasattr(value, "item") and not isinstance(value, (str, bytes)):
-        try:
-            value = value.item()
-        except Exception:
-            pass
+    if not isinstance(value, (str, bytes)):
+        if hasattr(value, "ndim") and getattr(value, "ndim", 0):
+            return [_canon(v) for v in value.tolist()]  # ndarray → nested list
+        if hasattr(value, "item"):
+            try:
+                value = value.item()
+            except Exception:
+                pass
     if isinstance(value, float):
         if math.isnan(value):
             return "__nan__"

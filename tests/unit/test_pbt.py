@@ -151,3 +151,30 @@ class TestPBT:
         table = algo.rung_table
         assert table[0]["n"] == 1 and table[0]["budget"] == 1
         assert table[-1]["budget"] == 8
+
+
+class TestCheckpointPaths:
+    def test_empty_parent_dir_is_cold_start(self, tmp_path, monkeypatch):
+        import json as _json
+
+        from metaopt_tpu import client
+
+        root = str(tmp_path / "ckpt")
+        monkeypatch.setenv(client.CKPT_ROOT_ENV, root)
+        # the donor called checkpoint_paths (creating its dir) but died
+        # before saving anything
+        monkeypatch.setenv(client.TRIAL_INFO_ENV, _json.dumps(
+            {"id": "donor", "experiment": "e", "params": {}}
+        ))
+        client.checkpoint_paths()
+        monkeypatch.setenv(client.TRIAL_INFO_ENV, _json.dumps(
+            {"id": "kid", "experiment": "e", "params": {}, "parent": "donor"}
+        ))
+        own, parent = client.checkpoint_paths()
+        assert parent is None  # empty donor dir = cold start
+        # once the donor dir has content, the continuation restores it
+        import os as _os
+        with open(_os.path.join(root, "donor", "w.json"), "w") as f:
+            f.write("{}")
+        own, parent = client.checkpoint_paths()
+        assert parent and parent.endswith("donor")

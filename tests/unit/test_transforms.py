@@ -65,3 +65,72 @@ def test_transform_many_shapes(space):
     backs = cube.untransform_many(mat)
     assert [b["n"] for b in backs] == [p["n"] for p in pts]
     assert cube.transform_many([]).shape == (0, 5)
+
+
+class TestShapedDimensions:
+    """Array-shaped dims expand to one cube column per element."""
+
+    def shaped_space(self):
+        from metaopt_tpu.space import build_space
+
+        return build_space({
+            "w": "uniform(-1, 1, shape=(2, 2))",
+            "k": "uniform(1, 8, discrete=True, shape=2)",
+            "c": "choices(['a', 'b'], shape=2)",
+            "lr": "loguniform(1e-4, 1e-1)",
+        })
+
+    def test_column_expansion(self):
+        cube = UnitCube(self.shaped_space())
+        assert cube.n_dims == 9  # 4 + 2 + 2 + 1
+        assert cube.names[0] == "w[0, 0]" and cube.names[-1] == "lr"
+        assert cube.categorical_mask.tolist()[6:8] == [True, True]
+        assert cube.n_choices.tolist() == [1, 1, 1, 1, 1, 1, 2, 2, 1]
+
+    def test_roundtrip_preserves_shapes_and_values(self):
+        space = self.shaped_space()
+        cube = UnitCube(space)
+        for pt in space.sample(5, seed=11):
+            back = cube.untransform(cube.transform(pt))
+            assert np.asarray(back["w"]).shape == (2, 2)
+            np.testing.assert_allclose(
+                np.asarray(back["w"], float), np.asarray(pt["w"], float),
+                atol=1e-9,
+            )
+            assert np.asarray(back["k"]).tolist() == np.asarray(pt["k"]).tolist()
+            assert list(back["c"]) == list(pt["c"])
+            assert back in space
+
+    def test_list_valued_points_transform_like_arrays(self):
+        # params round-trip the JSON ledgers as nested lists
+        space = self.shaped_space()
+        cube = UnitCube(space)
+        pt = space.sample(1, seed=2)[0]
+        as_lists = {
+            k: np.asarray(v).tolist() if not np.isscalar(v) else v
+            for k, v in pt.items()
+        }
+        np.testing.assert_allclose(cube.transform(pt), cube.transform(as_lists))
+        assert space.hash_point(pt) == space.hash_point(as_lists)
+
+    def test_trial_normalizes_arrays_for_json(self):
+        import json as _json
+
+        from metaopt_tpu.ledger.trial import Trial
+
+        space = self.shaped_space()
+        pt = space.sample(1, seed=4)[0]
+        t = Trial(params=pt, experiment="e")
+        _json.dumps(t.to_dict())  # must not raise
+        assert isinstance(t.params["w"], list)
+
+    def test_mixed_type_categorical_options_survive(self):
+        from metaopt_tpu.space import build_space
+
+        space = build_space({"c": "choices([1, 'a'], shape=2)"})
+        cube = UnitCube(space)
+        pt = {"c": [1, "a"]}
+        back = cube.untransform(cube.transform(pt))
+        assert back["c"] == [1, "a"]  # 1 stays an int, not '1'
+        assert back in space
+        assert space.hash_point(back) == space.hash_point(pt)
