@@ -57,7 +57,14 @@ class GridSearch(BaseAlgorithm):
         self._cursor = 0
 
     def _dim_cardinality(self, j: int) -> Optional[int]:
+        """Per-COLUMN (element) cardinality — a shaped dim's column owns one
+        element, not the whole array's cartesian product."""
+        from metaopt_tpu.space.dimensions import Integer
+
         dim = self.cube.dims[j]
+        if isinstance(dim, Integer):
+            low, high = dim.interval()
+            return int(high - low + 1)
         card = getattr(dim, "cardinality", None)
         if card is None or card == float("inf"):
             return None

@@ -128,10 +128,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "(replays completed trials into the algorithm)")
 
     db = sub.add_parser("db", help="ledger backend utilities")
-    db.add_argument("action", choices=["test"],
+    db.add_argument("action", choices=["test", "rm"],
                     help="test: drive the full backend contract (create, "
                          "dup-detect, reserve CAS, heartbeat, stale "
-                         "release) against the configured ledger")
+                         "release) against the configured ledger; "
+                         "rm: delete an experiment and its trials")
+    db.add_argument("-n", "--name", help="experiment to delete (rm)")
+    db.add_argument("--force", action="store_true",
+                    help="rm: required to actually delete")
     db.add_argument("--config", help="framework config YAML")
     db.add_argument("--ledger",
                     help="ledger spec: 'memory', a dir path, 'native:<dir>', "
@@ -587,6 +591,26 @@ def _cmd_db(args, cfg: Dict[str, Any]) -> int:
     )
 
     ledger = _make_ledger_from_spec(args.ledger, cfg)
+    if args.action == "rm":
+        # ref: `orion db rm` in the lineage — destructive, so --force gates
+        if not args.name:
+            raise SystemExit("db rm needs an experiment name (-n/--name)")
+        doc = ledger.load_experiment(args.name)
+        if doc is None:
+            raise SystemExit(f"no such experiment: {args.name}")
+        n = ledger.count(args.name)
+        if not args.force:
+            raise SystemExit(
+                f"would delete experiment {args.name!r} and its {n} "
+                "trial(s); re-run with --force"
+            )
+        if not ledger.delete_experiment(args.name):
+            raise SystemExit(
+                f"backend {type(ledger).__name__} does not support deletion"
+            )
+        print(f"deleted experiment {args.name!r} ({n} trials)")
+        return 0
+
     name = f"_dbtest-{os.getpid()}-{int(os.times().elapsed * 1000)}"
     results: List[tuple] = []
 

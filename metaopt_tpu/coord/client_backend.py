@@ -138,6 +138,9 @@ class CoordLedgerClient(LedgerBackend):
     def list_experiments(self) -> List[str]:
         return self._call("list_experiments")
 
+    def delete_experiment(self, name: str) -> bool:
+        return bool(self._call("delete_experiment", name=name))
+
     # -- trials ------------------------------------------------------------
     def register(self, trial: Trial) -> None:
         self._call("register", trial=trial.to_dict())

@@ -310,8 +310,9 @@ class CoordServer:
     #: by request id. Read-only ops re-execute harmlessly and are not cached
     #: (a fetch reply on a big experiment is MBs — caching those pins memory).
     _MUTATING_OPS = frozenset(
-        {"create_experiment", "update_experiment", "register", "reserve",
-         "update_trial", "release_stale", "set_signal"}
+        {"create_experiment", "update_experiment", "delete_experiment",
+         "register", "reserve", "update_trial", "release_stale",
+         "set_signal"}
     )
 
     def _hosted_producer(self, name: str):
@@ -414,7 +415,17 @@ class CoordServer:
                 self._replies[req] = reply
                 while len(self._replies) > self._replies_cap:
                     self._replies.popitem(last=False)
-            return reply
+        if op == "delete_experiment" and reply.get("ok") and reply.get("result"):
+            # durability: restore() merges a stale snapshot's docs back in,
+            # which would RESURRECT the deleted experiment after a crash —
+            # so persist the post-delete state now. Outside _lock: snapshot
+            # takes _snap_lock → _lock (AB-BA with housekeeping otherwise).
+            if self.snapshot_path:
+                try:
+                    self.snapshot(self.snapshot_path)
+                except Exception:
+                    log.exception("post-delete snapshot failed")
+        return reply
 
     def _dispatch(self, op: Optional[str], a: Dict[str, Any]) -> Any:
         with self._lock:
@@ -432,6 +443,18 @@ class CoordServer:
                 return None
             if op == "list_experiments":
                 return self.inner.list_experiments()
+            if op == "delete_experiment":
+                name = a["name"]
+                ok = bool(self.inner.delete_experiment(name))
+                if ok:
+                    # hosted algorithm + pending signals die with the docs
+                    with self._producers_guard:
+                        self._producers.pop(name, None)
+                    self._signals = {
+                        k: v for k, v in self._signals.items() if k[0] != name
+                    }
+                    self._event("delete_experiment", name)
+                return ok
             if op == "register":
                 trial = Trial.from_dict(a["trial"])
                 self.inner.register(trial)

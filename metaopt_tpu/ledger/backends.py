@@ -148,7 +148,9 @@ class MemoryLedger(LedgerBackend):
             if name in self._experiments:
                 raise DuplicateExperimentError(name)
             self._experiments[name] = dict(config)
-            self._trials.setdefault(name, {})
+            # a fresh experiment must not inherit ghost trials left by a
+            # register that raced a delete_experiment of the same name
+            self._trials[name] = {}
 
     def load_experiment(self, name: str) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -301,12 +303,19 @@ class FileLedger(LedgerBackend):
 
     # -- experiment docs --------------------------------------------------
     def create_experiment(self, config: Dict[str, Any]) -> None:
+        import shutil
+
         name = config["name"]
         with self._locked(name):
             epath = os.path.join(self._edir(name), "experiment.json")
             if os.path.exists(epath):
                 raise DuplicateExperimentError(name)
-            os.makedirs(os.path.join(self._edir(name), "trials"), exist_ok=True)
+            tdir = os.path.join(self._edir(name), "trials")
+            if os.path.isdir(tdir):
+                # ghost docs from a register that raced delete_experiment:
+                # a fresh experiment must not inherit them
+                shutil.rmtree(tdir, ignore_errors=True)
+            os.makedirs(tdir, exist_ok=True)
             self._write_json(epath, config)
 
     def load_experiment(self, name: str) -> Optional[Dict[str, Any]]:

@@ -295,7 +295,10 @@ class Categorical(Dimension):
 
     @property
     def cardinality(self) -> float:
-        return float(len(self.options))
+        # like Integer: a shaped dim is the product over its elements
+        return float(len(self.options)) ** max(
+            1, int(np.prod(self.shape)) if self.shape else 1
+        )
 
 
 class Fidelity(Dimension):

@@ -108,6 +108,19 @@ def test_db_test_passes_on_file_backend(tmp_path, capsys):
     assert ledger.list_experiments() == []
 
 
+def test_db_rm_requires_force_then_deletes(tmp_path, capsys):
+    led = seeded_experiment(tmp_path)
+    with pytest.raises(SystemExit, match="--force"):
+        cli_main(["db", "rm", "-n", "seeded", "--ledger", led])
+    assert cli_main(["db", "rm", "-n", "seeded", "--ledger", led,
+                     "--force"]) == 0
+    assert "deleted experiment 'seeded' (5 trials)" in capsys.readouterr().out
+    ledger = _make_ledger_from_spec(led, {})
+    assert ledger.load_experiment("seeded") is None
+    with pytest.raises(SystemExit, match="no such experiment"):
+        cli_main(["db", "rm", "-n", "seeded", "--ledger", led, "--force"])
+
+
 def test_plot_lcurve_ascii_and_no_fidelity_error(tmp_path, capsys):
     led = seeded_fidelity_experiment(tmp_path)
     assert cli_main(["plot", "lcurve", "-n", "fid", "--ledger", led]) == 0

@@ -455,3 +455,42 @@ class TestHostedProducer:
             # the rebuilt hosted algorithm replayed the restored completions
             algo = s2._producers["resume"][0].algorithm
             assert len(algo._observed) >= 3
+
+
+class TestDeleteExperiment:
+    def test_delete_rpc_clears_docs_producer_and_signals(self, server):
+        c = _client(server)
+        c.create_experiment({
+            "name": "exp", "space": {"x": "uniform(0, 1)"},
+            "algorithm": {"random": {"seed": 0}}, "max_trials": 5,
+        })
+        c.register(_trial(0.5))
+        t = c.reserve("exp", "w1")
+        c.set_signal("exp", t.id, "stop")
+        # hosted producer materializes
+        c.produce("exp", 1)
+        assert c.delete_experiment("exp") is True
+        assert c.load_experiment("exp") is None
+        assert c.fetch("exp") == []
+        assert c.delete_experiment("exp") is False  # already gone
+        with server._producers_guard:
+            assert "exp" not in server._producers
+        assert not any(k[0] == "exp" for k in server._signals)
+
+    def test_delete_survives_restart(self, tmp_path):
+        # restore() merges snapshot docs back in — a delete must persist a
+        # fresh snapshot or the experiment resurrects after a crash
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap, snapshot_interval_s=3600) as s1:
+            c = _client(s1)
+            c.create_experiment({"name": "exp", "max_trials": 5})
+            c.register(_trial(0.5))
+            c.snapshot()  # periodic snapshot captured the pre-delete state
+            assert c.delete_experiment("exp") is True
+            # crash here (no orderly stop-snapshot): simulate by not
+            # letting the context manager's stop() run a final snapshot
+            s1.snapshot_path = None
+        with CoordServer(snapshot_path=snap) as s2:
+            c2 = _client(s2)
+            assert c2.load_experiment("exp") is None
+            assert c2.fetch("exp") == []
