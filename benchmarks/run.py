@@ -169,6 +169,11 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str,
         "--ledger", os.path.join(ledger_root, name),
         "--exp-max-broken", "3",
         "--timeout-s", "900",  # a wedged trial must not sink the sweep
+        # one compile per program, not per trial: identical shapes across a
+        # sweep make the persistent XLA cache the dominant trials/hour
+        # lever for short trials (single host here, so CPU AOT reuse is
+        # safe too)
+        "--jax-cache", os.path.join(ledger_root, name, "jax-cache"),
     ]
     if spec["config"]:
         argv += ["--config", spec["config"]]

@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
                       help="checkpoint root for PBT weight handoff "
                            "(scripts resolve it via "
                            "client.checkpoint_paths())")
+    hunt.add_argument("--jax-cache", dest="jax_cache", default=None,
+                      help="persistent XLA compilation cache dir shared by "
+                           "all trials: trial N reuses trial 1's compile "
+                           "(don't share the dir across heterogeneous "
+                           "hosts)")
     hunt.add_argument("cmd", nargs=argparse.REMAINDER,
                       help="user script and its args with ~priors")
 
@@ -299,6 +304,7 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
             timeout_s=args.timeout_s,
             profile_dir=args.profile_dir,
             ckpt_root=args.ckpt_root or cfg.get("ckpt_root"),
+            jax_cache_dir=args.jax_cache or cfg.get("jax_cache"),
         )
     else:
         executor = SubprocessExecutor(
@@ -308,6 +314,7 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
             timeout_s=args.timeout_s,
             profile_dir=args.profile_dir,
             ckpt_root=args.ckpt_root or cfg.get("ckpt_root"),
+            jax_cache_dir=args.jax_cache or cfg.get("jax_cache"),
         )
 
     worker_id = args.worker_id or f"{os.uname().nodename}-{os.getpid()}"
@@ -531,25 +538,16 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
 
 def _plot_lcurve(args, ledger) -> int:
     """Objective vs fidelity budget per lineage (ASHA/Hyperband/PBT/DEHB)."""
-    exp = Experiment(args.name, ledger).configure()
-    fid = exp.space.fidelity if exp.space is not None else None
-    if fid is None:
+    from metaopt_tpu.io.webapi import lcurve_series
+
+    fid_name, curves = lcurve_series(ledger, args.name)
+    if fid_name is None:
         raise SystemExit(
             f"{args.name!r} has no fidelity dimension — lcurve needs a "
             "multi-fidelity experiment"
         )
-    curves: Dict[str, List] = {}
-    for t in exp.fetch_completed_trials():
-        if t.objective is None or fid.name not in t.params:
-            continue
-        lineage = t.lineage or exp.space.hash_point(t.params)
-        curves.setdefault(lineage, []).append(
-            {"budget": int(t.params[fid.name]), "objective": t.objective}
-        )
-    for pts in curves.values():
-        pts.sort(key=lambda p: p["budget"])
     if args.as_json:
-        print(json.dumps({"experiment": args.name, "fidelity": fid.name,
+        print(json.dumps({"experiment": args.name, "fidelity": fid_name,
                           "lcurves": curves}, indent=2))
         return 0
     if not curves:
@@ -557,7 +555,7 @@ def _plot_lcurve(args, ledger) -> int:
         return 0
     budgets = sorted({p["budget"] for pts in curves.values() for p in pts})
     header = "lineage".ljust(14) + "".join(f"{b:>12}" for b in budgets)
-    print(f"learning curves ({args.name}), objective per {fid.name}:")
+    print(f"learning curves ({args.name}), objective per {fid_name}:")
     print(header)
     # deepest-then-best first; cap the table at 20 lineages
     ranked = sorted(

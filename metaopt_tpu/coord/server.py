@@ -416,6 +416,11 @@ class CoordServer:
                 while len(self._replies) > self._replies_cap:
                     self._replies.popitem(last=False)
         if op == "delete_experiment" and reply.get("ok") and reply.get("result"):
+            # the hosted algorithm dies with the experiment — popped here,
+            # outside _lock, because _hosted_producer nests the two locks
+            # in the opposite order (_producers_guard → _lock)
+            with self._producers_guard:
+                self._producers.pop((msg.get("args") or {}).get("name"), None)
             # durability: restore() merges a stale snapshot's docs back in,
             # which would RESURRECT the deleted experiment after a crash —
             # so persist the post-delete state now. Outside _lock: snapshot
@@ -447,9 +452,12 @@ class CoordServer:
                 name = a["name"]
                 ok = bool(self.inner.delete_experiment(name))
                 if ok:
-                    # hosted algorithm + pending signals die with the docs
-                    with self._producers_guard:
-                        self._producers.pop(name, None)
+                    # pending signals die with the docs. The hosted
+                    # producer is popped later, OUTSIDE _lock (the
+                    # post-reply hook in _handle): taking _producers_guard
+                    # here would AB-BA against _hosted_producer, which
+                    # holds _producers_guard while its ledger ops take
+                    # _lock
                     self._signals = {
                         k: v for k, v in self._signals.items() if k[0] != name
                     }

@@ -44,6 +44,7 @@ class SubprocessExecutor(Executor):
         extra_env: Optional[Dict[str, str]] = None,
         profile_dir: Optional[str] = None,
         ckpt_root: Optional[str] = None,
+        jax_cache_dir: Optional[str] = None,
     ):
         self.template = template
         self.working_dir = working_dir
@@ -56,6 +57,20 @@ class SubprocessExecutor(Executor):
             self.extra_env["METAOPT_TPU_PROFILE_DIR"] = profile_dir
         if ckpt_root:  # PBT weight handoff root (client.checkpoint_paths)
             self.extra_env["METAOPT_TPU_CKPT_ROOT"] = ckpt_root
+        # Persistent XLA compilation cache shared across trials (opt-in,
+        # `hunt --jax-cache DIR`): every trial of a sweep traces the same
+        # program modulo hyperparameter VALUES (shapes are static), so
+        # trial N reuses trial 1's compile — the biggest trials/hour lever
+        # for short TPU trials. Opt-in because XLA:CPU caches are AOT
+        # machine code: sharing the dir across heterogeneous hosts risks
+        # SIGILL, a call the user must make.
+        if jax_cache_dir:
+            cache = os.path.expanduser(jax_cache_dir)
+            os.makedirs(cache, exist_ok=True)
+            self.extra_env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+            self.extra_env.setdefault(
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1"
+            )
 
     # -- env/argv assembly -------------------------------------------------
     def _prepare(self, trial: Trial, tmpdir: str) -> tuple[List[str], Dict[str, str], str]:

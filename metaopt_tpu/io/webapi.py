@@ -11,6 +11,8 @@ coordinator's write path:
 - ``GET /experiments/{name}``             → full document + stats (mtpu info)
 - ``GET /experiments/{name}/trials``      → trial docs (``?status=`` filter)
 - ``GET /experiments/{name}/regret``      → best-so-far series (mtpu plot)
+- ``GET /experiments/{name}/lcurves``     → objective per fidelity budget
+  per lineage (mtpu plot lcurve)
 - ``GET /healthz``                        → liveness
 
 Deliberately read-only: every write still flows through the single-writer
@@ -72,6 +74,33 @@ def regret_series(ledger: LedgerBackend, name: str) -> List[Dict[str, Any]]:
     return out
 
 
+def lcurve_series(ledger: LedgerBackend, name: str):
+    """(fidelity_name, {lineage: [{budget, objective}...]}) or (None, {}).
+
+    Shared by `mtpu plot lcurve` and GET /experiments/{name}/lcurves.
+    """
+    from metaopt_tpu.space import build_space
+
+    doc = ledger.load_experiment(name)
+    if doc is None or not doc.get("space"):
+        return None, {}
+    space = build_space(doc["space"])
+    fid = space.fidelity
+    if fid is None:
+        return None, {}
+    curves: Dict[str, List[Dict[str, Any]]] = {}
+    for t in ledger.fetch(name, "completed"):
+        if t.objective is None or fid.name not in t.params:
+            continue
+        lineage = t.lineage or space.hash_point(t.params)
+        curves.setdefault(lineage, []).append(
+            {"budget": int(t.params[fid.name]), "objective": t.objective}
+        )
+    for pts in curves.values():
+        pts.sort(key=lambda p: p["budget"])
+    return fid.name, curves
+
+
 class _Handler(BaseHTTPRequestHandler):
     ledger: LedgerBackend  # set by make_server on the class
 
@@ -103,7 +132,7 @@ class _Handler(BaseHTTPRequestHandler):
             return 200, {"routes": [
                 "/experiments", "/experiments/{name}",
                 "/experiments/{name}/trials", "/experiments/{name}/regret",
-                "/healthz",
+                "/experiments/{name}/lcurves", "/healthz",
             ]}
         if parts == ["healthz"]:
             return 200, {"ok": True}
@@ -127,6 +156,12 @@ class _Handler(BaseHTTPRequestHandler):
         if parts[2] == "regret":
             return 200, {"experiment": name,
                          "regret": regret_series(ledger, name)}
+        if parts[2] == "lcurves":
+            fid_name, curves = lcurve_series(ledger, name)
+            if fid_name is None:
+                return 400, {"error": f"{name!r} has no fidelity dimension"}
+            return 200, {"experiment": name, "fidelity": fid_name,
+                         "lcurves": curves}
         return 404, {"error": f"unknown route /{'/'.join(parts)}"}
 
 

@@ -93,6 +93,11 @@ class Dimension:
     def __contains__(self, value: Any) -> bool:
         raise NotImplementedError
 
+    @property
+    def n_elements(self) -> int:
+        """Scalar count of one value of this dimension (1 unless shaped)."""
+        return max(1, int(np.prod(self.shape)) if self.shape else 1)
+
     def _each(self, value) -> Iterable[Any]:
         if self.shape:
             # object dtype: mixed-type categorical options (e.g. [1, 'a'])
@@ -244,9 +249,7 @@ class Integer(Dimension):
 
     @property
     def cardinality(self) -> float:
-        return float(self._high - self._low + 1) ** max(
-            1, int(np.prod(self.shape)) if self.shape else 1
-        )
+        return float(self._high - self._low + 1) ** self.n_elements
 
 
 class Categorical(Dimension):
@@ -296,9 +299,7 @@ class Categorical(Dimension):
     @property
     def cardinality(self) -> float:
         # like Integer: a shaped dim is the product over its elements
-        return float(len(self.options)) ** max(
-            1, int(np.prod(self.shape)) if self.shape else 1
-        )
+        return float(len(self.options)) ** self.n_elements
 
 
 class Fidelity(Dimension):

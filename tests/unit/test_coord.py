@@ -477,6 +477,39 @@ class TestDeleteExperiment:
             assert "exp" not in server._producers
         assert not any(k[0] == "exp" for k in server._signals)
 
+    def test_delete_vs_cold_produce_never_deadlocks(self, server):
+        # regression: delete took _producers_guard INSIDE _lock while
+        # _hosted_producer takes _lock inside _producers_guard — concurrent
+        # cold produce + delete could AB-BA wedge the whole coordinator
+        c = _client(server)
+
+        def spin(tag, op):
+            cc = _client(server)
+            for i in range(15):
+                try:
+                    op(cc, i)
+                except Exception:
+                    pass  # missing experiment etc. — liveness is the test
+
+        def produce_op(cc, i):
+            cc.create_experiment({
+                "name": "churn", "space": {"x": "uniform(0, 1)"},
+                "algorithm": {"random": {"seed": 0}}, "max_trials": 99,
+            })
+            cc.produce("churn", 2)
+
+        def delete_op(cc, i):
+            cc.delete_experiment("churn")
+
+        threads = [threading.Thread(target=spin, args=("p", produce_op)),
+                   threading.Thread(target=spin, args=("d", delete_op))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "coordinator wedged"
+        assert c.ping()["pong"]  # still serving
+
     def test_delete_survives_restart(self, tmp_path):
         # restore() merges snapshot docs back in — a delete must persist a
         # fresh snapshot or the experiment resurrects after a crash

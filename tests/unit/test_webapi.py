@@ -73,6 +73,39 @@ def test_regret_series(served):
     assert bests == [2.0, 1.0, 0.0]
 
 
+def test_lcurves_endpoint(served):
+    # the fixture's space has no fidelity dimension → 400 with a clear error
+    with pytest.raises(urllib.error.HTTPError) as err:
+        get(f"{served}/experiments/api/lcurves")
+    assert err.value.code == 400
+
+
+def test_lcurves_endpoint_with_fidelity():
+    ledger = MemoryLedger()
+    space = build_space({"x": "uniform(-5, 5)",
+                         "epochs": "fidelity(1, 4, base=2)"})
+    exp = Experiment("fid", ledger, space=space, max_trials=10).configure()
+    for budget in (1, 2, 4):
+        t = exp.make_trial({"x": 1.0, "epochs": budget})
+        exp.register_trials([t])
+        got = exp.reserve_trial("w")
+        exp.push_results(
+            got,
+            [{"name": "o", "type": "objective", "value": 1.0 / budget}],
+        )
+    server = make_server(ledger)
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    try:
+        status, doc = get(f"http://{host}:{port}/experiments/fid/lcurves")
+        assert status == 200 and doc["fidelity"] == "epochs"
+        (curve,) = doc["lcurves"].values()
+        assert [p["budget"] for p in curve] == [1, 2, 4]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_unknown_routes_404(served):
     for path in ("/experiments/ghost", "/nope", "/experiments/api/nope"):
         with pytest.raises(urllib.error.HTTPError) as err:
