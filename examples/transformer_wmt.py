@@ -15,7 +15,13 @@ The trial shards dp×tp over exactly the chips its sub-slice grant names
 
 import argparse
 
+from metaopt_tpu import client
 from metaopt_tpu.client import report_results
+
+
+def _ckpt_kwargs():
+    own, parent = client.checkpoint_paths()
+    return {"save_dir": own, "restore_dir": parent or own}
 
 
 def main():
@@ -48,6 +54,10 @@ def main():
         sp=a.sp,
         ep=a.ep,
         steps=a.epochs * a.steps_per_epoch,
+        # orbax trial checkpoints: a PBT continuation restores its parent's
+        # training state; a suspended/re-run trial resumes its OWN
+        # (train_and_eval skips restore when the dir has no state yet)
+        **(_ckpt_kwargs() if client.IS_ORCHESTRATED else {}),
     )
     report_results([{"name": "loss", "type": "objective", "value": loss}])
 

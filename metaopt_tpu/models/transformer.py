@@ -346,8 +346,15 @@ def train_and_eval(
     seq_len: int = 64,
     steps: int = 100,
     seed: int = 0,
+    restore_dir: Optional[str] = None,
+    save_dir: Optional[str] = None,
 ) -> float:
-    """Train on the synthetic translation task; return final masked loss."""
+    """Train on the synthetic translation task; return final masked loss.
+
+    ``restore_dir``/``save_dir``: orbax trial checkpoints (params +
+    optimizer state) — how a PBT continuation inherits its parent's
+    training state and a suspended trial resumes (models/checkpoint.py).
+    """
     from metaopt_tpu.parallel.mesh import trial_mesh, use_mesh
 
     # sp > 1 shards the sequence axis (ring attention over ICI); ep > 1
@@ -372,6 +379,14 @@ def train_and_eval(
         params, opt_state, shardings = init_sharded(
             model, mesh, tx, (batch_size, seq_len), seed
         )
+        if restore_dir:
+            from metaopt_tpu.models.checkpoint import has_state, restore_state
+
+            if has_state(restore_dir):
+                params = restore_state(restore_dir + "/params", params,
+                                       shardings[0])
+                opt_state = restore_state(restore_dir + "/opt_state",
+                                          opt_state, shardings[1])
         step_fn = jax.jit(
             make_train_step(model, tx),
             in_shardings=(
@@ -389,6 +404,11 @@ def train_and_eval(
             params, opt_state, loss = step_fn(
                 params, opt_state, batch, jax.random.fold_in(kstep, i)
             )
+    if save_dir:
+        from metaopt_tpu.models.checkpoint import save_state
+
+        save_state(save_dir + "/params", params)
+        save_state(save_dir + "/opt_state", opt_state)
     return float(loss)
 
 

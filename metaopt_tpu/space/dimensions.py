@@ -76,7 +76,7 @@ class Dimension:
     def sample(self, n: int = 1, seed=None) -> List[Any]:
         """Draw ``n`` values (each of ``self.shape``) as Python/numpy values."""
         rng = _as_rng(seed)
-        count = n * int(np.prod(self.shape)) if self.shape else n
+        count = n * self.n_elements
         flat = self._sample_scalar(rng, count)
         if self.shape:
             return list(flat.reshape((n,) + self.shape))

@@ -69,7 +69,60 @@ print(f"proc {proc} OK: ring attention matched reference on "
 """
 
 
-def test_ring_attention_across_two_processes(tmp_path):
+TRAIN_CHILD = r"""
+import os, sys
+proc, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=proc)
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metaopt_tpu.models.data import synthetic_seq2seq
+from metaopt_tpu.models.transformer import (
+    init_sharded, make_model, make_train_step,
+)
+from metaopt_tpu.parallel.mesh import use_mesh
+from metaopt_tpu.parallel.sharding import shard_batch
+
+devs = jax.devices()
+assert len(devs) == 8
+# sp is the SLOWEST axis: its two groups are exactly the two processes, so
+# the ring-attention ppermute hops cross the process boundary every step
+mesh = Mesh(np.array(devs).reshape(2, 2, 2), ("sp", "dp", "tp"))
+
+model = make_model({"d_model": 64, "n_heads": 4, "n_layers": 2,
+                    "d_ff": 128, "vocab": 211, "dropout": 0.1})
+tx = optax.adamw(1e-3)
+batch, seq = 4, 16
+with use_mesh(mesh):
+    params, opt_state, shardings = init_sharded(model, mesh, tx, (batch, seq))
+    step = jax.jit(
+        make_train_step(model, tx),
+        in_shardings=(shardings[0], shardings[1],
+                      NamedSharding(mesh, P("dp")), None),
+        out_shardings=(shardings[0], shardings[1], None),
+        donate_argnums=(0, 1),
+    )
+    src, tgt = synthetic_seq2seq(jax.random.PRNGKey(1), batch, seq, model.vocab)
+    sharded = shard_batch(mesh, (src, tgt))
+    losses = []
+    for i in range(3):
+        params, opt_state, loss = step(
+            params, opt_state, sharded, jax.random.PRNGKey(i)
+        )
+        losses.append(float(loss))
+assert all(l == l and l > 0 for l in losses), losses
+assert losses[-1] < losses[0], f"loss must fall over steps: {losses}"
+print(f"proc {proc} OK: losses={[round(l, 4) for l in losses]}", flush=True)
+"""
+
+
+def _run_pair(child_src, timeout_s=220):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = str(s.getsockname()[1])
@@ -77,7 +130,7 @@ def test_ring_attention_across_two_processes(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", CHILD, str(i), port],
+            [sys.executable, "-c", child_src, str(i), port],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
             text=True,
         )
@@ -86,12 +139,31 @@ def test_ring_attention_across_two_processes(tmp_path):
     outs = []
     for i, p in enumerate(procs):
         try:
-            out, _ = p.communicate(timeout=220)
+            out, _ = p.communicate(timeout=timeout_s)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             pytest.fail(f"process {i} timed out (distributed init wedged?)")
         outs.append(out)
         assert p.returncode == 0, f"process {i} failed:\n{out}"
+    return outs
+
+
+def test_full_train_step_across_two_processes(tmp_path):
+    """The FULL sharded train step (params init, Megatron tp, ring
+    attention sp, optimizer update, psum'd loss) over a 2-process global
+    mesh — the multi-host training path end-to-end, with the sp ring
+    crossing the process boundary."""
+    outs = _run_pair(TRAIN_CHILD)
+    for i, out in enumerate(outs):
+        assert f"proc {i} OK" in out, out
+    # the psum'd loss is GLOBAL: both processes must report the same curve
+    curve0 = outs[0].splitlines()[-1].split("losses=")[1]
+    curve1 = outs[1].splitlines()[-1].split("losses=")[1]
+    assert curve0 == curve1
+
+
+def test_ring_attention_across_two_processes(tmp_path):
+    outs = _run_pair(CHILD)
     for i, out in enumerate(outs):
         assert f"proc {i} OK" in out, out

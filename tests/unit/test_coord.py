@@ -501,8 +501,11 @@ class TestDeleteExperiment:
         def delete_op(cc, i):
             cc.delete_experiment("churn")
 
-        threads = [threading.Thread(target=spin, args=("p", produce_op)),
-                   threading.Thread(target=spin, args=("d", delete_op))]
+        # daemon: a regression must FAIL the test, not hang pytest at exit
+        threads = [
+            threading.Thread(target=spin, args=("p", produce_op), daemon=True),
+            threading.Thread(target=spin, args=("d", delete_op), daemon=True),
+        ]
         for t in threads:
             t.start()
         for t in threads:
