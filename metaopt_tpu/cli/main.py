@@ -118,10 +118,12 @@ def build_parser() -> argparse.ArgumentParser:
     info.add_argument("--json", action="store_true", dest="as_json")
 
     plot = sub.add_parser("plot", help="optimization diagnostics")
-    plot.add_argument("kind", choices=["regret", "lcurve"],
+    plot.add_argument("kind", choices=["regret", "lcurve", "parallel"],
                       help="regret: best-objective-so-far per completed "
                            "trial; lcurve: objective vs fidelity budget per "
-                           "lineage (multi-fidelity experiments)")
+                           "lineage (multi-fidelity experiments); parallel: "
+                           "parallel-coordinates data (params + objective "
+                           "per completed trial, JSON)")
     common(plot)
     plot.add_argument("--json", action="store_true", dest="as_json")
 
@@ -510,6 +512,8 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
         raise SystemExit(f"no such experiment: {args.name}")
     if args.kind == "lcurve":
         return _plot_lcurve(args, ledger)
+    if args.kind == "parallel":
+        return _plot_parallel(args, ledger)
     points = regret_series(ledger, args.name)
     if args.as_json:
         print(json.dumps({"experiment": args.name, "regret": points},
@@ -533,6 +537,37 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
         print(f"{label:>12.4g} |{''.join(row)}")
     print(f"{'':>12} +{'-' * len(bests)}")
     print(f"final best: {bests[-1]:.6g}")
+    return 0
+
+
+def _plot_parallel(args, ledger) -> int:
+    """Parallel-coordinates export: one row per completed trial.
+
+    Always JSON (the natural input for any parallel-coordinates renderer);
+    without --json a compact table prints instead.
+    """
+    from metaopt_tpu.io.webapi import parallel_series
+
+    dims, rows = parallel_series(ledger, args.name)
+    if args.as_json:
+        print(json.dumps({"experiment": args.name, "dimensions": dims,
+                          "trials": rows}, indent=2))
+        return 0
+    if not rows:
+        print("no completed trials")
+        return 0
+    widths = {d: max(len(d), 10) for d in dims}
+    header = "  ".join(d.ljust(widths[d]) for d in dims) + "  objective"
+    print(header)
+    for r in sorted(rows, key=lambda r: r["objective"])[:40]:
+        cells = []
+        for d in dims:
+            v = r[d]
+            s = f"{v:.4g}" if isinstance(v, float) else str(v)
+            cells.append(s.ljust(widths[d]))
+        print("  ".join(cells) + f"  {r['objective']:.6g}")
+    if len(rows) > 40:
+        print(f"... {len(rows) - 40} more (use --json for all)")
     return 0
 
 

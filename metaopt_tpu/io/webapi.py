@@ -74,6 +74,21 @@ def regret_series(ledger: LedgerBackend, name: str) -> List[Dict[str, Any]]:
     return out
 
 
+def parallel_series(ledger: LedgerBackend, name: str):
+    """(dimensions, rows) for parallel-coordinates rendering.
+
+    Shared by `mtpu plot parallel` and GET /experiments/{name}/parallel.
+    """
+    doc = ledger.load_experiment(name) or {}
+    dims = sorted((doc.get("space") or {}).keys())
+    rows = [
+        {**{d: t.params.get(d) for d in dims}, "objective": t.objective}
+        for t in ledger.fetch(name, "completed")
+        if t.objective is not None
+    ]
+    return dims, rows
+
+
 def lcurve_series(ledger: LedgerBackend, name: str):
     """(fidelity_name, {lineage: [{budget, objective}...]}) or (None, {}).
 
@@ -132,7 +147,8 @@ class _Handler(BaseHTTPRequestHandler):
             return 200, {"routes": [
                 "/experiments", "/experiments/{name}",
                 "/experiments/{name}/trials", "/experiments/{name}/regret",
-                "/experiments/{name}/lcurves", "/healthz",
+                "/experiments/{name}/lcurves",
+                "/experiments/{name}/parallel", "/healthz",
             ]}
         if parts == ["healthz"]:
             return 200, {"ok": True}
@@ -162,6 +178,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return 400, {"error": f"{name!r} has no fidelity dimension"}
             return 200, {"experiment": name, "fidelity": fid_name,
                          "lcurves": curves}
+        if parts[2] == "parallel":
+            dims, rows = parallel_series(ledger, name)
+            return 200, {"experiment": name, "dimensions": dims,
+                         "trials": rows}
         return 404, {"error": f"unknown route /{'/'.join(parts)}"}
 
 

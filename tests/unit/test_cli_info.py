@@ -108,6 +108,19 @@ def test_db_test_passes_on_file_backend(tmp_path, capsys):
     assert ledger.list_experiments() == []
 
 
+def test_plot_parallel(tmp_path, capsys):
+    led = seeded_experiment(tmp_path)
+    assert cli_main(["plot", "parallel", "-n", "seeded", "--ledger", led,
+                     "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["dimensions"] == ["x"]
+    assert len(doc["trials"]) == 5
+    assert all(set(r) == {"x", "objective"} for r in doc["trials"])
+    assert cli_main(["plot", "parallel", "-n", "seeded", "--ledger", led]) == 0
+    out = capsys.readouterr().out
+    assert out.splitlines()[0].startswith("x")  # table header
+
+
 def test_db_rm_requires_force_then_deletes(tmp_path, capsys):
     led = seeded_experiment(tmp_path)
     with pytest.raises(SystemExit, match="--force"):

@@ -106,6 +106,14 @@ def test_lcurves_endpoint_with_fidelity():
         server.server_close()
 
 
+def test_parallel_endpoint(served):
+    status, doc = get(f"{served}/experiments/api/parallel")
+    assert status == 200
+    assert doc["dimensions"] == ["x"]
+    assert len(doc["trials"]) == 3
+    assert all(set(r) == {"x", "objective"} for r in doc["trials"])
+
+
 def test_unknown_routes_404(served):
     for path in ("/experiments/ghost", "/nope", "/experiments/api/nope"):
         with pytest.raises(urllib.error.HTTPError) as err:
