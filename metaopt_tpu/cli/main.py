@@ -47,6 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
     common(hunt)
     hunt.add_argument("--worker-trials", type=int, dest="worker_trials")
     hunt.add_argument("--worker-id", default=None)
+    hunt.add_argument("--n-workers", type=int, dest="n_workers", default=1,
+                      help="parallel workers in this process (each runs the "
+                           "full produce/reserve/execute loop; trials are "
+                           "subprocesses, so N trials run concurrently)")
     hunt.add_argument("--exp-max-broken", type=int, default=None,
                       help="abort after this many broken trials")
     hunt.add_argument("--working-dir")
@@ -295,12 +299,9 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
     n_chips = args.n_chips if args.n_chips is not None else (
         (cfg.get("executor") or {}).get("n_chips")
     )
-    if n_chips:
-        from metaopt_tpu.executor.tpu import TPUExecutor
 
-        executor = TPUExecutor(
-            template,
-            n_chips=int(n_chips),
+    def make_executor(tmpl):
+        kwargs = dict(
             working_dir=args.working_dir or cfg.get("working_dir"),
             interpreter=interpreter,
             timeout_s=args.timeout_s,
@@ -308,22 +309,13 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
             ckpt_root=args.ckpt_root or cfg.get("ckpt_root"),
             jax_cache_dir=args.jax_cache or cfg.get("jax_cache"),
         )
-    else:
-        executor = SubprocessExecutor(
-            template,
-            working_dir=args.working_dir or cfg.get("working_dir"),
-            interpreter=interpreter,
-            timeout_s=args.timeout_s,
-            profile_dir=args.profile_dir,
-            ckpt_root=args.ckpt_root or cfg.get("ckpt_root"),
-            jax_cache_dir=args.jax_cache or cfg.get("jax_cache"),
-        )
+        if n_chips:
+            from metaopt_tpu.executor.tpu import TPUExecutor
 
-    worker_id = args.worker_id or f"{os.uname().nodename}-{os.getpid()}"
-    stats = workon(
-        exp,
-        executor,
-        worker_id=worker_id,
+            return TPUExecutor(tmpl, n_chips=int(n_chips), **kwargs)
+        return SubprocessExecutor(tmpl, **kwargs)
+
+    workon_kwargs = dict(
         worker_trials=(
             args.worker_trials
             if args.worker_trials is not None
@@ -333,23 +325,95 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
         heartbeat_timeout_s=cfg.get("heartbeat_s", 30.0) * 2,
         producer_mode=args.producer or cfg.get("producer") or "local",
     )
-    executor.close()
+    worker_id = args.worker_id or f"{os.uname().nodename}-{os.getpid()}"
+    n_workers = max(1, int(getattr(args, "n_workers", 1) or 1))
+    if n_workers == 1:
+        executor = make_executor(template)
+        try:
+            all_stats = [workon(exp, executor, worker_id=worker_id,
+                                **workon_kwargs)]
+        finally:
+            executor.close()
+    else:
+        # N full produce/reserve/execute loops in this process (the
+        # lineage's `--n-workers`): trials are subprocesses, so N run
+        # concurrently. Each loop gets its own Experiment/ledger handle
+        # (coord sockets aren't shared across threads) and its own
+        # executor; the ledger's atomic reserve arbitrates exactly as it
+        # does between separate worker processes.
+        import threading
+
+        results: Dict[int, Any] = {}
+        errors: Dict[int, str] = {}
+        stop = threading.Event()
+
+        def run(i: int) -> None:
+            try:
+                w_exp, w_template = _experiment_from_args(
+                    args, cfg, need_cmd=False
+                )
+                ex = make_executor(w_template)
+                try:
+                    results[i] = workon(
+                        w_exp, ex, worker_id=f"{worker_id}-w{i}",
+                        stop_event=stop, **workon_kwargs
+                    )
+                finally:
+                    ex.close()
+            except BaseException as err:  # a dead worker must be REPORTED
+                errors[i] = f"{type(err).__name__}: {err}"
+
+        threads = [
+            threading.Thread(target=run, args=(i,), daemon=True)
+            for i in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for t in threads:
+                while t.is_alive():
+                    t.join(timeout=0.5)
+        except KeyboardInterrupt:
+            # wind down cleanly: each loop finishes its in-flight trial,
+            # marks state, and closes its executor before exiting
+            print("interrupt: waiting for in-flight trials...",
+                  file=sys.stderr)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        all_stats = [results[i] for i in sorted(results)]
+        if not all_stats:
+            raise SystemExit(
+                "every worker thread failed: "
+                + "; ".join(f"w{i}: {e}" for i, e in sorted(errors.items()))
+            )
+        for i, e in sorted(errors.items()):
+            print(f"worker w{i} died: {e}", file=sys.stderr)
+
     s = exp.stats
-    timings = {
-        k: round(v, 4) if isinstance(v, float) else v
-        for k, v in stats.producer_timings.items()
-    }
+    # element-wise aggregate across workers (counters sum; each worker ran
+    # its own producer, so summed seconds = total suggest/observe cost)
+    timings: Dict[str, Any] = {}
+    for st in all_stats:
+        for k, v in st.producer_timings.items():
+            timings[k] = timings.get(k, 0) + v if isinstance(v, (int, float)) \
+                else v
+    timings = {k: round(v, 4) if isinstance(v, float) else v
+               for k, v in timings.items()}
+    failed = len(all_stats) < n_workers
     print(json.dumps({
         "experiment": exp.name,
         "worker": worker_id,
-        "completed_by_worker": stats.completed,
-        "broken_by_worker": stats.broken,
-        "pruned_by_worker": stats.pruned,
+        "n_workers": n_workers,
+        "failed_workers": n_workers - len(all_stats),
+        "completed_by_worker": sum(st.completed for st in all_stats),
+        "broken_by_worker": sum(st.broken for st in all_stats),
+        "pruned_by_worker": sum(st.pruned for st in all_stats),
         "producer_timings": timings,
         "total": s["by_status"],
         "best": s["best"],
     }, indent=2))
-    return 0 if s["best"] is not None else 1
+    return 0 if (s["best"] is not None and not failed) else 1
 
 
 def _cmd_init_only(args, cfg: Dict[str, Any]) -> int:

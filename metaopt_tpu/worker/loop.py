@@ -49,6 +49,7 @@ def workon(
     idle_sleep_s: float = 0.05,
     max_idle_cycles: int = 200,
     producer_mode: str = "local",
+    stop_event: Optional[Any] = None,
 ) -> WorkerStats:
     """Run trials until the experiment finishes (or this worker's cap hits).
 
@@ -59,6 +60,10 @@ def workon(
     ``producer_mode="coord"`` delegates suggestion (and the judge hook) to
     the coordinator's single hosted algorithm instance instead of fitting a
     local copy — requires the ``coord://`` ledger backend.
+
+    ``stop_event`` (a ``threading.Event``-like): checked between trials —
+    how `hunt --n-workers` winds its worker threads down cleanly on Ctrl-C
+    (the in-flight trial finishes, the executor closes).
     """
     algo: Optional[BaseAlgorithm]
     if producer_mode == "coord":
@@ -80,6 +85,9 @@ def workon(
         return producer.judge(trial, partial)
 
     while not experiment.is_done:
+        if stop_event is not None and stop_event.is_set():
+            log.info("%s: stop requested — winding down", worker_id)
+            break
         if worker_trials is not None and stats.reserved >= worker_trials:
             log.info("%s: worker_trials cap (%d) reached", worker_id, worker_trials)
             break
