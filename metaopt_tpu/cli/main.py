@@ -13,6 +13,7 @@ import json
 import logging
 import os
 import sys
+import time
 from typing import Any, Dict, List, Optional
 
 from metaopt_tpu.executor import SubprocessExecutor
@@ -343,15 +344,26 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
         # does between separate worker processes.
         import threading
 
+        from metaopt_tpu.coord.client_backend import CoordLedgerClient
+
         results: Dict[int, Any] = {}
         errors: Dict[int, str] = {}
         stop = threading.Event()
+        shared_ledger = not isinstance(exp.ledger, CoordLedgerClient)
 
         def run(i: int) -> None:
             try:
-                w_exp, w_template = _experiment_from_args(
-                    args, cfg, need_cmd=False
-                )
+                if shared_ledger:
+                    # memory/file/native backends are thread-safe: every
+                    # worker MUST share one ledger or (memory especially)
+                    # each thread would race a private universe
+                    w_exp = Experiment(exp.name, exp.ledger).configure()
+                    w_template = template
+                else:
+                    # coord sockets are per-thread: build a fresh client
+                    w_exp, w_template = _experiment_from_args(
+                        args, cfg, need_cmd=False
+                    )
                 ex = make_executor(w_template)
                 try:
                     results[i] = workon(
@@ -374,13 +386,20 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
                 while t.is_alive():
                     t.join(timeout=0.5)
         except KeyboardInterrupt:
-            # wind down cleanly: each loop finishes its in-flight trial,
-            # marks state, and closes its executor before exiting
-            print("interrupt: waiting for in-flight trials...",
-                  file=sys.stderr)
+            # wind down: each loop finishes its in-flight trial, marks
+            # state, and closes its executor. The wait is bounded by the
+            # trial timeout (or 300s when unbounded); anything still
+            # running after that is abandoned to the heartbeat stale sweep.
             stop.set()
+            grace = (args.timeout_s + 30) if args.timeout_s else 300
+            print(f"interrupt: waiting up to {grace:.0f}s for in-flight "
+                  "trials...", file=sys.stderr)
+            deadline = time.monotonic() + grace
             for t in threads:
-                t.join(timeout=60)
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if any(t.is_alive() for t in threads):
+                print("some trials still running — their reservations will "
+                      "be re-freed by the stale sweep", file=sys.stderr)
         all_stats = [results[i] for i in sorted(results)]
         if not all_stats:
             raise SystemExit(

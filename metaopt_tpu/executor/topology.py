@@ -141,7 +141,8 @@ class ChipRegistry:
         if self._local is not None:
             return self._local.n_free_chips
         state = self._file_op("read")
-        used = sum(b["size"] for b in state["claims"].values())
+        # block size lives in the claim KEY ("start:size"), not the value
+        used = sum(int(key.split(":")[1]) for key in state["claims"])
         return self.total - used
 
     # -- file-backed path --------------------------------------------------

@@ -11,6 +11,7 @@ broken trial never leaks capacity.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -47,6 +48,16 @@ class TPUExecutor(SubprocessExecutor):
         p = 1
         while p * 2 <= total:
             p *= 2
+        if registry is None and registry_path is None:
+            # default to the flock'd per-host state file: every executor on
+            # this host — other hunt PROCESSES and `--n-workers` threads
+            # alike — must arbitrate the same physical chips, not each
+            # believe the whole slice is free
+            import tempfile
+
+            registry_path = os.path.join(
+                tempfile.gettempdir(), f"metaopt_tpu-chips-{p}.json"
+            )
         self.registry = registry or ChipRegistry(p, state_path=registry_path)
         self.allocate_timeout_s = allocate_timeout_s
         self.allocate_poll_s = allocate_poll_s

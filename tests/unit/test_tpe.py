@@ -33,6 +33,10 @@ class TestInternals:
         assert pad_pow2(8) == 8
         assert pad_pow2(9) == 16
         assert pad_pow2(1000) == 1024
+        assert pad_pow2(4096) == 4096
+        assert pad_pow2(4097) == 8192
+        assert pad_pow2(10001) == 12288  # not 16384: 4096-step past 4096
+        assert pad_pow2(12289) == 16384
 
     def test_adaptive_bandwidths(self):
         mu = np.array([0.1, 0.5, 0.9])
@@ -163,3 +167,16 @@ class TestSuggest:
         clone_space, clone = make_tpe(seed=5)
         clone.load_state_dict(tpe.state_dict())
         assert clone.suggest(2) == tpe.suggest(2)
+
+    def test_score_ranks_good_region_above_bad(self):
+        # objective improves toward x = -8: after observing, the EI score
+        # (log l - log g) must rank a good-region point above a bad one
+        space, tpe = make_tpe(seed=7)
+        assert tpe.score({"x": 0.0, "c": "a"}) == 0.0  # unfitted: indifferent
+        for i, x in enumerate([-9, -8, -7, -6, 2, 4, 6, 8, 9, 10]):
+            tpe.observe(
+                [completed(space, {"x": float(x), "c": "a"}, abs(x + 8.0))]
+            )
+        good = tpe.score({"x": -8.0, "c": "a"})
+        bad = tpe.score({"x": 9.0, "c": "a"})
+        assert good > bad
