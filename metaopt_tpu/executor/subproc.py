@@ -76,7 +76,7 @@ class SubprocessExecutor(Executor):
     def _prepare(self, trial: Trial, tmpdir: str) -> tuple[List[str], Dict[str, str], str]:
         results_path = os.path.join(tmpdir, "results.json")
         config_out = None
-        if self.template.config_template is not None:
+        if self.template.has_config:
             ext = os.path.splitext(self.template.config_path or "c.yaml")[1]
             config_out = os.path.join(tmpdir, f"trial_config{ext}")
             self.template.materialize_config(trial.params, config_out)

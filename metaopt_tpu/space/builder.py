@@ -41,9 +41,18 @@ _TOKEN_RE = re.compile(
     re.VERBOSE | re.DOTALL,
 )
 
+#: ``name~prior(...)`` occurrences inside a TEXT config template (the
+#: lineage's generic-converter fallback): one nesting level of parens so
+#: kwargs like ``shape=(2, 2)`` parse
+_TEXT_RE = re.compile(
+    r"(?P<name>[A-Za-z_][A-Za-z0-9_.\-]*)"
+    r"~(?P<expr>[A-Za-z_][A-Za-z0-9_]*\((?:[^()]|\([^()]*\))*\))"
+)
+
 #: prior-name → dimension class routing (``discrete=True`` reroutes to Integer)
 _REAL_PRIORS = {"uniform", "loguniform", "normal"}
 _INT_PRIORS = {"randint"}
+_KNOWN_PRIORS = _REAL_PRIORS | _INT_PRIORS | {"choices", "fidelity"}
 
 
 class PriorSyntaxError(ValueError):
@@ -131,6 +140,8 @@ class CommandTemplate:
         config_template: Optional[Dict[str, Any]] = None,
         config_slots: Optional[Dict[str, str]] = None,  # dotted path -> param name
         config_argv_index: Optional[int] = None,
+        config_text: Optional[str] = None,        # generic TEXT template
+        config_text_slots: Optional[Dict[str, str]] = None,  # token -> param
     ) -> None:
         self.argv = list(argv)
         self.slots = dict(slots)
@@ -138,6 +149,8 @@ class CommandTemplate:
         self.config_template = config_template
         self.config_slots = dict(config_slots or {})
         self.config_argv_index = config_argv_index
+        self.config_text = config_text
+        self.config_text_slots = dict(config_text_slots or {})
 
     def format(self, params: Mapping[str, Any], config_out: Optional[str] = None) -> List[str]:
         out = list(self.argv)
@@ -150,6 +163,19 @@ class CommandTemplate:
 
     def materialize_config(self, params: Mapping[str, Any], out_path: str) -> None:
         """Write the user config file with priors replaced by concrete values."""
+        if self.config_text is not None:
+            # generic text template: ONE regex pass replacing whole
+            # `name~prior(...)` tokens — sequential str.replace would let a
+            # dim whose name suffixes another's (lr vs wlr) corrupt it
+            slots = self.config_text_slots
+
+            def fill(m: "re.Match[str]") -> str:
+                pname = slots.get(m.group(0))
+                return str(params[pname]) if pname is not None else m.group(0)
+
+            with open(out_path, "w") as f:
+                f.write(_TEXT_RE.sub(fill, self.config_text))
+            return
         if self.config_template is None:
             raise RuntimeError("no config template attached")
         data = copy.deepcopy(self.config_template)
@@ -162,8 +188,16 @@ class CommandTemplate:
         infer_converter(out_path).generate(out_path, data)
 
     @property
+    def has_config(self) -> bool:
+        return self.config_template is not None or self.config_text is not None
+
+    @property
     def param_names(self) -> List[str]:
-        return [n for n, _ in self.slots.values()] + list(self.config_slots.values())
+        return (
+            [n for n, _ in self.slots.values()]
+            + list(self.config_slots.values())
+            + list(self.config_text_slots.values())
+        )
 
 
 class SpaceBuilder:
@@ -176,6 +210,9 @@ class SpaceBuilder:
         config_template: Optional[Dict[str, Any]] = None
         config_slots: Dict[str, str] = {}
         config_argv_index: Optional[int] = None
+
+        config_text: Optional[str] = None
+        config_text_slots: Dict[str, str] = {}
 
         for i, tok in enumerate(user_argv):
             m = _TOKEN_RE.match(tok)
@@ -193,11 +230,60 @@ class SpaceBuilder:
                     for dotted, (pname, expr) in config_slots.items():
                         space.register(parse_prior(pname, expr))
                     config_slots = {d: p for d, (p, _) in config_slots.items()}
+                    continue
+            if config_path is None and i > 0:
+                # generic fallback (lineage's GenericConverter): ANY text
+                # config carrying `name~prior(...)` tokens becomes a
+                # textual template — ini/gin/toml/whatever, format untouched
+                found_text = self._scan_text_config(tok)
+                if found_text:
+                    config_path = tok
+                    config_argv_index = i
+                    config_text, text_priors = found_text
+                    for pname, (token, expr) in text_priors.items():
+                        space.register(parse_prior(pname, expr))
+                        config_text_slots[token] = pname
 
         template = CommandTemplate(
-            user_argv, slots, config_path, config_template, config_slots, config_argv_index
+            user_argv, slots, config_path, config_template, config_slots,
+            config_argv_index, config_text, config_text_slots,
         )
         return space, template
+
+    @staticmethod
+    def _scan_text_config(path: str):
+        """Generic text template: find ``name~prior(...)`` tokens in a file.
+
+        Returns (raw text, {param name: (full token, prior expr)}) or None
+        when the path isn't a readable modest-size text file with tokens.
+        Script sources (.py/.sh) are excluded — the script is the thing
+        being RUN, not a config to rewrite.
+        """
+        import os
+
+        if path.endswith((".py", ".sh")) or not os.path.isfile(path):
+            return None
+        try:
+            if os.path.getsize(path) > 1 << 20:
+                return None
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError):
+            return None
+        found: Dict[str, Tuple[str, str]] = {}
+        for m in _TEXT_RE.finditer(text):
+            name, expr, token = m.group("name"), m.group("expr"), m.group(0)
+            # only KNOWN priors turn a file into a template: prose like
+            # "see y~f(x)" in an inert data file must stay inert
+            if expr.split("(", 1)[0].lower() not in _KNOWN_PRIORS:
+                continue
+            if name in found and found[name][1] != expr:
+                raise PriorSyntaxError(
+                    f"{path}: dimension {name!r} declared twice with "
+                    f"different priors ({found[name][1]!r} vs {expr!r})"
+                )
+            found[name] = (token, expr)
+        return (text, found) if found else None
 
     @staticmethod
     def _scan_config(path: str):

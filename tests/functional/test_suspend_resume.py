@@ -1,0 +1,100 @@
+"""Suspend → resume with training-state continuity.
+
+Ties three subsystems together end-to-end: the algorithm's
+``should_suspend`` hook parks a reserved trial without executing it
+(`suspended` status), the resume path flips it back to ``new``, and when
+it finally runs, the subprocess script restores its own orbax-style
+checkpoint via ``client.checkpoint_paths`` — so work done before a
+suspension (here: by the same lineage's earlier trials) is never lost.
+"""
+
+import json
+import os
+
+from metaopt_tpu.executor import SubprocessExecutor
+from metaopt_tpu.ledger import Experiment
+from metaopt_tpu.ledger.backends import make_ledger
+from metaopt_tpu.space import SpaceBuilder
+from metaopt_tpu.worker import workon
+
+from tests.dumbalgo import DumbAlgo
+
+SCRIPT = """\
+import argparse, json, os
+from metaopt_tpu import client
+
+p = argparse.ArgumentParser()
+p.add_argument("--lr", type=float, required=True)
+a = p.parse_args()
+own, parent = client.checkpoint_paths()
+w, warm = 10.0, 0
+state = os.path.join(own, "w.json")
+if os.path.exists(state):
+    with open(state) as f:
+        w, warm = json.load(f)["w"], 1
+for _ in range(4):
+    w -= a.lr * 2.0 * (w - 3.0)
+with open(state, "w") as f:
+    json.dump({"w": w}, f)
+client.report_results([
+    {"name": "loss", "type": "objective", "value": (w - 3.0) ** 2},
+    {"name": "warm", "type": "statistic", "value": warm},
+])
+"""
+
+
+def test_suspended_trial_resumes_with_own_checkpoint(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(SCRIPT)
+    ledger = make_ledger({"type": "file", "path": str(tmp_path / "led")})
+    space, template = SpaceBuilder().build(
+        [str(script), "--lr~uniform(0.1, 0.3)"]
+    )
+    exp = Experiment(
+        "sr", ledger, space=space, max_trials=4,
+        algorithm={"dumbalgo": {}},
+    ).configure()
+
+    # the algorithm parks lr=0.25 on sight; the others run
+    algo = DumbAlgo(
+        space,
+        scripted=[{"lr": 0.25}, {"lr": 0.1}, {"lr": 0.2}, {"lr": 0.3}],
+        suspend_if={"lr": 0.25},
+        done_after=3,
+    )
+    import sys
+
+    executor = SubprocessExecutor(
+        template, interpreter=[sys.executable],
+        ckpt_root=str(tmp_path / "ckpt"),
+    )
+    stats = workon(exp, executor, "w0", algorithm=algo, max_idle_cycles=30)
+    assert stats.suspended == 1
+    (parked,) = exp.fetch_trials("suspended")
+    assert parked.params == {"lr": 0.25}
+
+    # simulate an earlier run of the SAME trial id having saved state
+    # (e.g. it ran pre-suspension elsewhere): its checkpoint dir exists
+    ck = tmp_path / "ckpt" / parked.id
+    ck.mkdir(parents=True, exist_ok=True)
+    (ck / "w.json").write_text(json.dumps({"w": 3.5}))
+
+    # resume: suspended → new, then a worker picks it up and the script
+    # restores the checkpoint instead of cold-starting at w=10
+    parked.transition("new")
+    parked.worker = None
+    assert ledger.update_trial(parked, expected_status="suspended")
+    algo2 = DumbAlgo(space, done_after=0)
+    exp2 = Experiment("sr", ledger).configure()
+    workon(exp2, executor, "w1", algorithm=algo2, max_idle_cycles=20)
+    executor.close()
+
+    done = {t.params["lr"]: t for t in exp2.fetch_completed_trials()}
+    assert set(done) == {0.25, 0.1, 0.2, 0.3}
+    resumed = done[0.25]
+    warm = next(r.value for r in resumed.statistics if r.name == "warm")
+    assert warm == 1, "resumed trial must restore its own checkpoint"
+    # w started at 3.5 (checkpoint), not 10: loss is already tiny
+    assert resumed.objective < 0.1
+    cold = done[0.1]
+    assert next(r.value for r in cold.statistics if r.name == "warm") == 0

@@ -97,3 +97,76 @@ class TestSpaceBuilderConfigFile:
         assert data["epochs"] == 10
         argv_out = tmpl.format({"width": 64, "lr": 0.01}, config_out=str(out_cfg))
         assert str(out_cfg) in argv_out
+
+
+class TestGenericTextTemplate:
+    """The lineage's generic-converter fallback: priors in ANY text config."""
+
+    def test_ini_style_template(self, tmp_path):
+        cfg = tmp_path / "train.gin"
+        cfg.write_text(
+            "# experiment config\n"
+            "[optimizer]\n"
+            "learning_rate = lr~loguniform(1e-4, 1e-1)\n"
+            "momentum = mom~uniform(0, 1)\n"
+            "epochs = 10\n"
+        )
+        argv = ["./train.py", "--config", str(cfg)]
+        space, tmpl = SpaceBuilder().build(argv)
+        assert set(space.keys()) == {"lr", "mom"}
+        assert tmpl.has_config and tmpl.config_text is not None
+
+        out = tmp_path / "trial.gin"
+        tmpl.materialize_config({"lr": 0.01, "mom": 0.9}, str(out))
+        text = out.read_text()
+        assert "learning_rate = 0.01" in text
+        assert "momentum = 0.9" in text
+        assert "epochs = 10" in text          # untouched
+        assert "# experiment config" in text  # comments survive
+
+    def test_repeated_token_replaced_everywhere(self, tmp_path):
+        cfg = tmp_path / "c.toml"
+        cfg.write_text("a = lr~uniform(0, 1)\nb = lr~uniform(0, 1)\n")
+        space, tmpl = SpaceBuilder().build(["t.py", str(cfg)])
+        assert set(space.keys()) == {"lr"}
+        out = tmp_path / "o.toml"
+        tmpl.materialize_config({"lr": 0.5}, str(out))
+        assert out.read_text() == "a = 0.5\nb = 0.5\n"
+
+    def test_conflicting_priors_for_one_name_raise(self, tmp_path):
+        from metaopt_tpu.space.builder import PriorSyntaxError
+
+        cfg = tmp_path / "c.cfg"
+        cfg.write_text("a = lr~uniform(0, 1)\nb = lr~uniform(0, 2)\n")
+        with pytest.raises(PriorSyntaxError, match="declared twice"):
+            SpaceBuilder().build(["t.py", str(cfg)])
+
+    def test_scripts_and_plain_files_are_not_templates(self, tmp_path):
+        script = tmp_path / "helper.py"
+        script.write_text("x = 'lr~uniform(0, 1)'  # not a config\n")
+        plain = tmp_path / "notes.txt"
+        plain.write_text("no priors here\n")
+        space, tmpl = SpaceBuilder().build(
+            ["t.py", str(script), str(plain), "--lr~uniform(0, 1)"]
+        )
+        assert tmpl.config_text is None
+        assert set(space.keys()) == {"lr"}
+
+    def test_suffix_name_collision_substitutes_correctly(self, tmp_path):
+        # lr is a suffix of wlr: a sequential replace would mangle wlr's token
+        cfg = tmp_path / "c.cfg"
+        cfg.write_text("a = lr~uniform(0, 1)\nb = wlr~uniform(0, 1)\n")
+        space, tmpl = SpaceBuilder().build(["t.py", str(cfg)])
+        assert set(space.keys()) == {"lr", "wlr"}
+        out = tmp_path / "o.cfg"
+        tmpl.materialize_config({"lr": 0.5, "wlr": 0.9}, str(out))
+        assert out.read_text() == "a = 0.5\nb = 0.9\n"
+
+    def test_unknown_prior_shaped_prose_stays_inert(self, tmp_path):
+        notes = tmp_path / "notes.txt"
+        notes.write_text("see y~f(x) for details; also z~wobble(3)\n")
+        space, tmpl = SpaceBuilder().build(
+            ["t.py", str(notes), "--lr~uniform(0, 1)"]
+        )
+        assert tmpl.config_text is None
+        assert set(space.keys()) == {"lr"}
