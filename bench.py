@@ -34,36 +34,12 @@ from metaopt_tpu.utils.procs import run_with_deadline, tpu_backend_reachable
 
 
 def preflight_backend(timeout_s: float = 90.0) -> None:
-    """Fall back to CPU if the TPU backend is unreachable.
+    """Fall back to CPU if the TPU backend is unreachable (shared doctrine
+    in metaopt_tpu.utils.procs.preflight_backend)."""
+    from metaopt_tpu.utils.procs import preflight_backend as _pf
 
-    The axon relay is single-slot and can wedge (a stuck claim makes ANY
-    ``import jax`` with PALLAS_AXON_POOL_IPS set hang indefinitely). Probe
-    it in a disposable subprocess first; on failure, scrub the axon env so
-    this process measures on CPU instead of hanging the driver.
-    """
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        # the axon sitecustomize's register() at interpreter startup can
-        # override the env-var platform selection — re-apply via the live
-        # config or the first device init still dials the (wedgeable) relay
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        return
-    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return
-    if tpu_backend_reachable(timeout_s):
-        return
-    print("bench preflight: TPU backend unreachable; measuring on CPU",
-          file=sys.stderr)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    # the axon sitecustomize imports jax at interpreter startup, so the env
-    # var above is snapshotted too late — re-apply via the live config
-    # (safe: no backend has been initialized yet in this process)
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    _pf(timeout_s,
+        announce="bench preflight: TPU backend unreachable; measuring on CPU")
 
 
 def build_tpe(n_obs: int, seed: int = 0):

@@ -221,9 +221,15 @@ class SpaceBuilder:
                 space.register(parse_prior(name, m.group("expr")))
                 slots[i] = (name, m.group("dashes"))
                 continue
-            if tok.endswith((".yaml", ".yml", ".json")) and config_path is None and i > 0:
+            if tok.endswith((".yaml", ".yml", ".json")) and i > 0:
                 found = self._scan_config(tok)
                 if found:
+                    if config_path is not None:
+                        raise PriorSyntaxError(
+                            f"two config templates carry priors "
+                            f"({config_path!r} and {tok!r}); only one "
+                            "config file per command may hold ~priors"
+                        )
                     config_path = tok
                     config_argv_index = i
                     config_template, config_slots = found
@@ -231,12 +237,18 @@ class SpaceBuilder:
                         space.register(parse_prior(pname, expr))
                     config_slots = {d: p for d, (p, _) in config_slots.items()}
                     continue
-            if config_path is None and i > 0:
+            elif i > 0:
                 # generic fallback (lineage's GenericConverter): ANY text
                 # config carrying `name~prior(...)` tokens becomes a
                 # textual template — ini/gin/toml/whatever, format untouched
                 found_text = self._scan_text_config(tok)
                 if found_text:
+                    if config_path is not None:
+                        raise PriorSyntaxError(
+                            f"two config templates carry priors "
+                            f"({config_path!r} and {tok!r}); only one "
+                            "config file per command may hold ~priors"
+                        )
                     config_path = tok
                     config_argv_index = i
                     config_text, text_priors = found_text
@@ -273,9 +285,14 @@ class SpaceBuilder:
         found: Dict[str, Tuple[str, str]] = {}
         for m in _TEXT_RE.finditer(text):
             name, expr, token = m.group("name"), m.group("expr"), m.group(0)
-            # only KNOWN priors turn a file into a template: prose like
-            # "see y~f(x)" in an inert data file must stay inert
+            # only tokens that fully PARSE as known priors turn a file into
+            # a template: prose like "see y~f(x)" or "lr~uniform(low, high)"
+            # in an inert data/doc file must stay inert
             if expr.split("(", 1)[0].lower() not in _KNOWN_PRIORS:
+                continue
+            try:
+                parse_prior(name, expr)
+            except PriorSyntaxError:
                 continue
             if name in found and found[name][1] != expr:
                 raise PriorSyntaxError(

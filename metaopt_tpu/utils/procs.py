@@ -64,6 +64,38 @@ def run_with_deadline(
             out_f.close()
 
 
+def preflight_backend(timeout_s: float = 90.0,
+                      announce: Optional[str] = None) -> bool:
+    """Make this process safe to initialize a jax backend; True = TPU live.
+
+    The single source of the probe-then-fall-back-to-CPU doctrine (used by
+    bench.py and ``__graft_entry__.entry``): with a wedged relay, the first
+    backend init in-process would hang forever, so probe in a deadline
+    child and, on failure (or when CPU is forced), scrub the axon env AND
+    re-apply the platform through the live jax config — the axon
+    sitecustomize's register() at interpreter startup otherwise overrides
+    the env-var selection.
+    """
+    def _force_cpu() -> None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax  # safe: import alone does not dial the relay
+
+        jax.config.update("jax_platforms", "cpu")
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        _force_cpu()
+        return False
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return tpu_backend_reachable(timeout_s)
+    if tpu_backend_reachable(timeout_s):
+        return True
+    if announce:
+        print(announce, file=sys.stderr)
+    _force_cpu()
+    return False
+
+
 def tpu_backend_reachable(timeout_s: float = 90.0) -> bool:
     """Can a fresh interpreter reach a TPU backend right now?
 

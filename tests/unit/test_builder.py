@@ -170,3 +170,22 @@ class TestGenericTextTemplate:
         )
         assert tmpl.config_text is None
         assert set(space.keys()) == {"lr"}
+
+    def test_nonliteral_known_prior_prose_stays_inert(self, tmp_path):
+        doc = tmp_path / "usage.txt"
+        doc.write_text("the space is lr~uniform(low, high) in general\n")
+        space, tmpl = SpaceBuilder().build(
+            ["t.py", str(doc), "--lr~uniform(0, 1)"]
+        )
+        assert tmpl.config_text is None
+        assert set(space.keys()) == {"lr"}
+
+    def test_two_templates_with_priors_raise(self, tmp_path):
+        from metaopt_tpu.space.builder import PriorSyntaxError
+
+        a = tmp_path / "a.gin"
+        a.write_text("x = lr~uniform(0, 1)\n")
+        b = tmp_path / "b.gin"
+        b.write_text("y = mom~uniform(0, 1)\n")
+        with pytest.raises(PriorSyntaxError, match="two config templates"):
+            SpaceBuilder().build(["t.py", str(a), str(b)])
