@@ -30,7 +30,7 @@ import time
 
 import numpy as np
 
-from metaopt_tpu.utils.procs import run_with_deadline, tpu_backend_reachable
+from metaopt_tpu.utils.procs import run_with_deadline
 
 
 def preflight_backend(timeout_s: float = 90.0) -> None:

@@ -114,10 +114,38 @@ class BaseAlgorithm(ABC):
         self._observed = dict(state.get("observed", {}))
 
 
+def _load_plugin(name: str) -> bool:
+    """Third-party discovery (the lineage's pkg_resources plugin role).
+
+    An installed distribution can expose algorithms via the
+    ``metaopt_tpu.algorithms`` entry-point group; loading the entry point
+    imports the module, whose ``@algo_registry.register`` decorator does
+    the rest. Returns True if something matching ``name`` was loaded.
+    """
+    try:
+        from importlib.metadata import entry_points
+
+        eps = list(entry_points(group="metaopt_tpu.algorithms"))
+    except Exception:  # discovery failure must not mask the KeyError
+        return False
+    for ep in eps:
+        if ep.name.lower() == name.lower():
+            # load OUTSIDE the guard: a plugin that fails to import must
+            # surface ITS error, not a bare unknown-algorithm KeyError
+            ep.load()
+            return name.lower() in algo_registry
+    return False
+
+
 def make_algorithm(space: Space, config: Dict[str, Any]) -> BaseAlgorithm:
     """Build from ``{"asha": {...}}``-style config (single key = algo name)."""
     if len(config) != 1:
         raise ValueError(f"algorithm config must have exactly one key, got {config}")
     (name, kwargs), = config.items()
-    cls = algo_registry.get(name)
+    try:
+        cls = algo_registry.get(name)
+    except KeyError:
+        if not _load_plugin(name):
+            raise
+        cls = algo_registry.get(name)
     return cls(space, **(kwargs or {}))

@@ -237,10 +237,13 @@ class SpaceBuilder:
                         space.register(parse_prior(pname, expr))
                     config_slots = {d: p for d, (p, _) in config_slots.items()}
                     continue
-            elif i > 0:
+            if i > 0:
                 # generic fallback (lineage's GenericConverter): ANY text
                 # config carrying `name~prior(...)` tokens becomes a
-                # textual template — ini/gin/toml/whatever, format untouched
+                # textual template — ini/gin/toml/whatever, format
+                # untouched. Deliberately NOT elif: a yaml-suffixed file
+                # whose structured scan failed (list top level, bad syntax)
+                # still gets the text scan instead of dropping its priors
                 found_text = self._scan_text_config(tok)
                 if found_text:
                     if config_path is not None:

@@ -87,7 +87,9 @@ def preflight_backend(timeout_s: float = 90.0,
         _force_cpu()
         return False
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return tpu_backend_reachable(timeout_s)
+        # directly-attached runtime (or none): nothing can wedge, so no
+        # probe child — don't tax the common local case with jax startup
+        return True
     if tpu_backend_reachable(timeout_s):
         return True
     if announce:

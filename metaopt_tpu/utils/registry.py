@@ -30,6 +30,9 @@ class Registry(Generic[T]):
 
         return deco
 
+    def __contains__(self, name: str) -> bool:
+        return name.lower() in self._entries
+
     def get(self, name: str) -> Type[T]:
         try:
             return self._entries[name.lower()]

@@ -52,6 +52,35 @@ class TestRegistryAndBase:
         with pytest.raises(ValueError):
             ASHA(make_space(fidelity=False))
 
+    def test_entry_point_plugin_discovery(self, monkeypatch):
+        """Unknown names consult the metaopt_tpu.algorithms entry-point
+        group (the lineage's pkg_resources plugin mechanism)."""
+        import importlib.metadata as md
+
+        from metaopt_tpu.algo.base import algo_registry
+
+        class FakeEP:
+            name = "myplugin"
+
+            @staticmethod
+            def load():
+                @algo_registry.register("myplugin")
+                class MyPlugin(Random):
+                    pass
+                return MyPlugin
+
+        def fake_entry_points(group=None):
+            return [FakeEP()] if group == "metaopt_tpu.algorithms" else []
+
+        monkeypatch.setattr(md, "entry_points", fake_entry_points)
+        try:
+            algo = make_algorithm(make_space(), {"myplugin": {"seed": 1}})
+            assert isinstance(algo, Random)
+        finally:
+            algo_registry._entries.pop("myplugin", None)
+        with pytest.raises(KeyError):  # non-plugin unknowns still raise
+            make_algorithm(make_space(), {"nope2": {}})
+
 
 class TestRandom:
     def test_deterministic_and_in_space(self):

@@ -189,3 +189,15 @@ class TestGenericTextTemplate:
         b.write_text("y = mom~uniform(0, 1)\n")
         with pytest.raises(PriorSyntaxError, match="two config templates"):
             SpaceBuilder().build(["t.py", str(a), str(b)])
+
+    def test_yaml_suffix_falls_through_to_text_scan(self, tmp_path):
+        # a .yaml file whose STRUCTURED scan fails (top-level list) still
+        # templates textually instead of silently dropping its priors
+        cfg = tmp_path / "sweep.yaml"
+        cfg.write_text("- lr~uniform(0, 1)\n- constant\n")
+        space, tmpl = SpaceBuilder().build(["t.py", str(cfg)])
+        assert set(space.keys()) == {"lr"}
+        assert tmpl.config_text is not None
+        out = tmp_path / "o.yaml"
+        tmpl.materialize_config({"lr": 0.25}, str(out))
+        assert out.read_text() == "- 0.25\n- constant\n"
