@@ -121,6 +121,37 @@ def test_plot_parallel(tmp_path, capsys):
     assert out.splitlines()[0].startswith("x")  # table header
 
 
+def test_resume_flips_suspended_trials(tmp_path, capsys):
+    led = str(tmp_path / "rledger")
+    ledger = _make_ledger_from_spec(led, {})
+    space = build_space({"x": "uniform(-5, 5)"})
+    exp = Experiment("susp", ledger, space=space, max_trials=9).configure()
+    ids = []
+    for x in (1.0, 2.0, 3.0):
+        t = exp.make_trial({"x": x})
+        exp.register_trials([t])
+        got = exp.reserve_trial("w")
+        got.transition("suspended")
+        assert ledger.update_trial(got, expected_status="reserved")
+        ids.append(got.id)
+
+    # one specific trial by id prefix
+    assert cli_main(["resume", "-n", "susp", "--ledger", led,
+                     "--trial-id", ids[0][:8]]) == 0
+    assert "resumed 1 trial(s)" in capsys.readouterr().out
+    assert ledger.get("susp", ids[0]).status == "new"
+    assert ledger.get("susp", ids[1]).status == "suspended"
+
+    # then the rest in bulk
+    assert cli_main(["resume", "-n", "susp", "--ledger", led]) == 0
+    assert "resumed 2 trial(s)" in capsys.readouterr().out
+    assert all(ledger.get("susp", i).status == "new" for i in ids)
+
+    with pytest.raises(SystemExit, match="no suspended trial"):
+        cli_main(["resume", "-n", "susp", "--ledger", led,
+                  "--trial-id", "zzzz"])
+
+
 def test_db_rm_requires_force_then_deletes(tmp_path, capsys):
     led = seeded_experiment(tmp_path)
     with pytest.raises(SystemExit, match="--force"):
