@@ -148,6 +148,8 @@ def build_parser() -> argparse.ArgumentParser:
     db.add_argument("-n", "--name", help="experiment to delete (rm)")
     db.add_argument("--force", action="store_true",
                     help="rm: required to actually delete")
+    db.add_argument("--json", action="store_true", dest="as_json",
+                    help="test: emit the check report as JSON")
     db.add_argument("--config", help="framework config YAML")
     db.add_argument("--ledger",
                     help="ledger spec: 'memory', a dir path, 'native:<dir>', "
@@ -801,6 +803,18 @@ def _cmd_db(args, cfg: Dict[str, Any]) -> int:
     except Exception:
         cleaned = False
     failed = [r for r in results if not r[1]]
+    if args.as_json:
+        print(json.dumps({
+            "backend": type(ledger).__name__,
+            "passed": len(results) - len(failed),
+            "total": len(results),
+            "cleaned": bool(cleaned),
+            # name the leftover so a JSON consumer can remove it later
+            **({} if cleaned else {"scratch": name}),
+            "checks": [{"check": d, "ok": ok, **({"error": e} if e else {})}
+                       for d, ok, e in results],
+        }, indent=2))
+        return 0 if not failed else 1
     for desc, ok, err in results:
         mark = "ok " if ok else "FAIL"
         print(f"  [{mark}] {desc}" + (f" — {err}" if err else ""))

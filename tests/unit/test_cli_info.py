@@ -108,6 +108,16 @@ def test_db_test_passes_on_file_backend(tmp_path, capsys):
     assert ledger.list_experiments() == []
 
 
+def test_db_test_json(tmp_path, capsys):
+    rc = cli_main(["db", "test", "--ledger", str(tmp_path / "dbt"),
+                   "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["passed"] == doc["total"] == 14
+    assert doc["cleaned"] is True
+    assert all(c["ok"] for c in doc["checks"])
+
+
 def test_plot_parallel(tmp_path, capsys):
     led = seeded_experiment(tmp_path)
     assert cli_main(["plot", "parallel", "-n", "seeded", "--ledger", led,
