@@ -25,24 +25,63 @@ def run_with_deadline(
     env: Optional[dict] = None,
     capture: bool = False,
     poll_s: float = 0.5,
+    stream: bool = False,
 ) -> Tuple[Optional[int], str]:
     """Run ``argv``; return ``(returncode, output)``.
 
     ``returncode`` is None when the deadline hit and the child was killed
     (possibly unreapably — the non-blocking reap is best-effort). ``output``
-    is combined stdout+stderr when ``capture`` else "".
+    is combined stdout+stderr when ``capture`` or ``stream``, else "".
+
+    ``stream=True`` additionally tees the child's output to this process's
+    stdout *as it is produced* (each poll tick), so an outer observer that
+    kills this process mid-run still sees everything the child printed so
+    far — a buffered-until-exit capture shows nothing on such a kill.
     """
-    out_f = tempfile.TemporaryFile() if capture else None
+    import codecs
+
+    out_f = tempfile.TemporaryFile() if (capture or stream) else None
+    streamed = 0  # bytes already teed to stdout
+    decoder = codecs.getincrementaldecoder("utf-8")("replace")
     try:
         proc = subprocess.Popen(
             argv, env=env,
-            stdout=out_f if capture else subprocess.DEVNULL,
-            stderr=subprocess.STDOUT if capture else subprocess.DEVNULL,
+            stdout=out_f if out_f is not None else subprocess.DEVNULL,
+            stderr=subprocess.STDOUT if out_f is not None else subprocess.DEVNULL,
         )
+
+        def _drain(pos: int) -> Tuple[bytes, int]:
+            # pread only: the child writes through a dup of this descriptor
+            # (one shared file offset), so a seek here would relocate the
+            # child's next write mid-file and corrupt the capture.
+            chunks = []
+            while out_f is not None:
+                try:
+                    blk = os.pread(out_f.fileno(), 1 << 16, pos)
+                except OSError:
+                    break
+                if not blk:
+                    break
+                chunks.append(blk)
+                pos += len(blk)
+            return b"".join(chunks), pos
+
+        def _tee() -> None:
+            nonlocal streamed
+            if not stream:
+                return
+            data, streamed = _drain(streamed)
+            if data:
+                # incremental decode: a multi-byte char split across ticks
+                # must not become U+FFFD in the live tail
+                sys.stdout.write(decoder.decode(data))
+                sys.stdout.flush()
+
         deadline = time.time() + timeout_s
         rc: Optional[int] = None
         while time.time() < deadline:
             rc = proc.poll()
+            _tee()
             if rc is not None:
                 break
             time.sleep(poll_s)
@@ -54,10 +93,11 @@ def run_with_deadline(
                 proc.wait(timeout=2.0)
             except subprocess.TimeoutExpired:
                 pass
+        _tee()  # flush whatever landed after the last tick (or the kill)
         output = ""
         if out_f is not None:
-            out_f.seek(0)
-            output = out_f.read().decode(errors="replace")
+            data, _ = _drain(0)
+            output = data.decode(errors="replace")
         return rc, output
     finally:
         if out_f is not None:

@@ -1,0 +1,45 @@
+"""run_with_deadline streaming: a killed child must leave a visible tail.
+
+MULTICHIP_r02 went red because the dryrun child's output was buffered in a
+temp file and only flushed after exit — a driver-side kill left an empty
+tail. stream=True tees output as it is produced, so these tests pin that a
+deadline kill still surfaces everything printed before the kill.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from metaopt_tpu.utils.procs import run_with_deadline
+
+
+def test_stream_tees_output_live(capfd):
+    code = "print('alpha', flush=True); print('beta', flush=True)"
+    rc, out = run_with_deadline(
+        [sys.executable, "-c", code], timeout_s=30.0,
+        capture=True, stream=True, poll_s=0.1,
+    )
+    assert rc == 0
+    assert "alpha" in out and "beta" in out
+    teed = capfd.readouterr().out
+    assert "alpha" in teed and "beta" in teed
+
+
+def test_stream_survives_deadline_kill(capfd):
+    # child prints progress then hangs: the kill must not eat the progress
+    code = "import time; print('step-1 done', flush=True); time.sleep(60)"
+    rc, out = run_with_deadline(
+        [sys.executable, "-c", code], timeout_s=2.0,
+        capture=True, stream=True, poll_s=0.1,
+    )
+    assert rc is None  # deadline hit
+    assert "step-1 done" in out
+    assert "step-1 done" in capfd.readouterr().out
+
+
+def test_capture_without_stream_unchanged(capfd):
+    rc, out = run_with_deadline(
+        [sys.executable, "-c", "print('quiet')"], timeout_s=30.0, capture=True,
+    )
+    assert rc == 0 and "quiet" in out
+    assert capfd.readouterr().out == ""  # no tee unless stream=True
