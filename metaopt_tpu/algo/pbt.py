@@ -25,6 +25,7 @@ Producer strips into ``Trial.parent`` before registration.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -35,6 +36,14 @@ from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.space import Space, UnitCube
 
 log = logging.getLogger(__name__)
+
+
+def _exploit_seed(tid: str) -> int:
+    """Process-stable RNG seed for one member's exploit/explore draw."""
+    digest = hashlib.blake2b(
+        f"{tid}:pbt-exploit".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big")
 
 
 @algo_registry.register("pbt")
@@ -196,10 +205,11 @@ class PBT(BaseAlgorithm):
                 # after coordinator restart) or a concurrent producer must
                 # regenerate the IDENTICAL continuation so ledger dedup can
                 # absorb it — so derive the donor choice and the explore
-                # perturbation from the trial id, not from shared RNG state
-                rng = np.random.default_rng(
-                    abs(hash((tid, "pbt-exploit"))) % (2 ** 63)
-                )
+                # perturbation from the trial id, not from shared RNG state.
+                # blake2b, not hash(): str hashes are salted per interpreter
+                # (PYTHONHASHSEED), which would break exactly the
+                # cross-process replay this seed exists for
+                rng = np.random.default_rng(_exploit_seed(tid))
                 donor_lineage, (d_obj, d_params, d_tid) = ranked[
                     int(rng.integers(k))
                 ]

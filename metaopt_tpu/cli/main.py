@@ -477,20 +477,12 @@ def _cmd_resume(args, cfg: Dict[str, Any]) -> int:
 
 def _cmd_list(args, cfg: Dict[str, Any]) -> int:
     """ref: `orion list` in the lineage — enumerate experiments."""
+    from metaopt_tpu.io.webapi import _experiment_summary
+
     ledger = _make_ledger_from_spec(args.ledger, cfg)
-    rows = []
-    for name in sorted(ledger.list_experiments()):
-        doc = ledger.load_experiment(name) or {}
-        completed = ledger.count(name, "completed")
-        rows.append({
-            "name": name,
-            "algorithm": next(iter(doc.get("algorithm", {})), "?"),
-            "trials": ledger.count(name),
-            "completed": completed,
-            "max_trials": doc.get("max_trials"),
-            "done": bool(doc.get("algo_done"))
-            or completed >= doc.get("max_trials", 0),
-        })
+    # same summary the web API serves: the two surfaces must agree on "done"
+    rows = [_experiment_summary(ledger, name)
+            for name in sorted(ledger.list_experiments())]
     if args.as_json:
         print(json.dumps(rows, indent=2))
     else:
@@ -499,7 +491,7 @@ def _cmd_list(args, cfg: Dict[str, Any]) -> int:
         for r in rows:
             flag = " [done]" if r["done"] else ""
             print(f"{r['name']}: {r['completed']}/{r['max_trials']} completed "
-                  f"({r['trials']} trials, {r['algorithm']}){flag}")
+                  f"({r['trials']} trials, {r['algorithm'] or '?'}){flag}")
     return 0
 
 

@@ -36,17 +36,24 @@ log = logging.getLogger(__name__)
 
 
 def _experiment_summary(ledger: LedgerBackend, name: str) -> Dict[str, Any]:
+    """One-line experiment status; also the backing store for ``mtpu list``.
+
+    Shared so the CLI and the REST surface can never disagree on what
+    "done" means. missing/None ``max_trials`` = unbounded (never done by
+    count alone).
+    """
     doc = ledger.load_experiment(name) or {}
     completed = ledger.count(name, "completed")
+    max_trials = doc.get("max_trials")
     return {
         "name": name,
         "version": doc.get("version", 1),
         "algorithm": next(iter(doc.get("algorithm", {})), None),
         "trials": ledger.count(name),
         "completed": completed,
-        "max_trials": doc.get("max_trials"),
+        "max_trials": max_trials,
         "done": bool(doc.get("algo_done"))
-        or completed >= doc.get("max_trials", float("inf")),
+        or (max_trials is not None and completed >= max_trials),
     }
 
 

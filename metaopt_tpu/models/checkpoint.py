@@ -16,12 +16,15 @@ index keys make the leaf order explicit and structure-independent.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 
 def _checkpointer():
@@ -67,6 +70,14 @@ def restore_state(path: str, like: Any, shardings: Optional[Any] = None) -> Any:
                 for x, s in zip(leaves, sharding_leaves)
             ]
         else:
+            # a silent fallback here would land a multi-host restore fully
+            # replicated on device 0 with no signal — make it loud
+            log.warning(
+                "restore_state(%s): shardings tree has %d leaves but the "
+                "checkpoint has %d — IGNORING shardings, restoring "
+                "unsharded (replicated on the default device)",
+                path, len(sharding_leaves), len(leaves),
+            )
             leaves = [jnp.asarray(x) for x in leaves]
     else:
         leaves = [jnp.asarray(x) for x in leaves]

@@ -143,6 +143,27 @@ class TestPBT:
 
         assert run() == run()
 
+    def test_exploit_seed_stable_across_interpreters(self):
+        # the exploit RNG seed must survive a coordinator restart or a
+        # concurrent producer process — i.e. be independent of the
+        # per-process str-hash salt. Pinned value = blake2b digest; a
+        # subprocess with a different PYTHONHASHSEED must agree.
+        import os
+        import subprocess
+        import sys
+
+        from metaopt_tpu.algo.pbt import _exploit_seed
+
+        assert _exploit_seed("trial-abc123") == 1852549890743809802
+        env = dict(os.environ, PYTHONHASHSEED="424242")
+        out = subprocess.check_output(
+            [sys.executable, "-c",
+             "from metaopt_tpu.algo.pbt import _exploit_seed;"
+             "print(_exploit_seed('trial-abc123'))"],
+            env=env,
+        )
+        assert int(out) == 1852549890743809802
+
     def test_rung_table(self):
         space = make_space()
         algo = PBT(space, seed=6, population_size=2)
