@@ -29,9 +29,9 @@ Irregular sequence lengths are padded up to block multiples with masked
 tails (``_block_and_pad``); block sizes never exceed the requested
 block_q/block_k.
 
-``MHA`` in metaopt_tpu.models.transformer routes here when
-``METAOPT_TPU_FLASH`` selects an implementation (see :func:`attention_impl`
-for why the Pallas kernel is opt-in rather than backend-default), and wraps
+``MHA`` in metaopt_tpu.models.transformer routes here by default on TPU
+backends (chunked twin; see :func:`attention_impl` for the selection table
+and why the Pallas kernel stays opt-in), and wraps
 the call in ``shard_map`` over the trial mesh (batch on "dp", heads on
 "tp") via :func:`sharded_flash_attention` — attention is embarrassingly
 parallel over (batch, head), so each shard runs the kernel locally and the
@@ -470,26 +470,31 @@ def sharded_flash_attention(
 def attention_impl() -> Optional[str]:
     """Which implementation MHA routes through, from ``METAOPT_TPU_FLASH``.
 
-    - unset/``0``/``off`` → ``None``: plain XLA reference attention.
-      Deliberately the default: the axon relay's remote-compile path cannot
-      build Mosaic (Pallas) programs — even a trivial pallas_call hangs —
-      so routing every Transformer trial through the Pallas kernel would
-      wedge on that setup.
+    - unset → backend default: **``chunked`` on TPU** (compiles on any TPU
+      runtime, including relay-tunneled ones, and keeps live attention
+      tiles O(Sq·block_k) instead of the reference path's O(S²) HBM logits
+      tensor), ``None`` (plain XLA reference) on CPU, where the O(S²) path
+      is faster at test shapes and numerically the oracle.
+    - ``0``/``off`` → ``None``: force the plain XLA reference attention.
     - ``1``/``pallas`` → the Pallas kernel (Mosaic on a directly-attached
       TPU; interpret mode elsewhere). Attention dropout still routes those
-      calls to the chunked twin.
-    - ``chunked``/``scan`` → the lax.scan twin: compiles on any backend,
-      including through the axon relay — the production training path
-      there.
+      calls to the chunked twin. Opt-in rather than TPU-default: bench.py's
+      r2 probe showed the relay *can* compile a trivial Mosaic program, but
+      the full flash kernel has no compiled-run record yet — bench.py
+      executes it behind a deadline child and records flash_pallas status
+      each TPU run (see its report before flipping this default).
+    - ``chunked``/``scan`` → force the lax.scan twin on any backend.
     """
     env = (os.environ.get("METAOPT_TPU_FLASH") or "").strip().lower()
-    if env in ("", "0", "false", "no", "off"):
+    if env in ("", None):
+        return "chunked" if jax.default_backend() == "tpu" else None
+    if env in ("0", "false", "no", "off"):
         return None
     if env in ("chunked", "scan", "2"):
         return "chunked"
     if env in ("1", "true", "yes", "on", "pallas"):
         return "pallas"
-    # a typo must not silently select the Mosaic path (which wedges on
+    # a typo must not silently select the Mosaic path (which can wedge on
     # relay-tunneled backends) — fail loudly instead
     raise ValueError(
         f"METAOPT_TPU_FLASH={env!r}: expected off/pallas/chunked"

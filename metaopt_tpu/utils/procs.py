@@ -105,7 +105,9 @@ def run_with_deadline(
 
 
 def preflight_backend(timeout_s: float = 90.0,
-                      announce: Optional[str] = None) -> bool:
+                      announce: Optional[str] = None,
+                      retries: int = 1,
+                      backoff_s: float = 0.0) -> bool:
     """Make this process safe to initialize a jax backend; True = TPU live.
 
     The single source of the probe-then-fall-back-to-CPU doctrine (used by
@@ -115,6 +117,10 @@ def preflight_backend(timeout_s: float = 90.0,
     re-apply the platform through the live jax config — the axon
     sitecustomize's register() at interpreter startup otherwise overrides
     the env-var selection.
+
+    ``retries``/``backoff_s``: re-probe a possibly-transient wedge before
+    surrendering to CPU (the relay sometimes recovers within a minute or
+    two); total worst-case budget ≈ retries·timeout_s + (retries−1)·backoff_s.
     """
     def _force_cpu() -> None:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -130,8 +136,14 @@ def preflight_backend(timeout_s: float = 90.0,
         # directly-attached runtime (or none): nothing can wedge, so no
         # probe child — don't tax the common local case with jax startup
         return True
-    if tpu_backend_reachable(timeout_s):
-        return True
+    for attempt in range(max(retries, 1)):
+        if tpu_backend_reachable(timeout_s):
+            return True
+        if attempt + 1 < retries:
+            if announce:
+                print(f"backend probe {attempt + 1}/{retries} failed; "
+                      f"retrying in {backoff_s:.0f}s", file=sys.stderr)
+            time.sleep(backoff_s)
     if announce:
         print(announce, file=sys.stderr)
     _force_cpu()
