@@ -19,7 +19,9 @@ variants total and suggest() latency stays flat past 10k observations.
 
 from __future__ import annotations
 
+import logging
 import math
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -102,17 +104,125 @@ class TPE(BaseAlgorithm):
         #: instead of one blocking launch+readback per point.
         self._prefetch: List[Dict[str, Any]] = []
         self._prefetch_n_obs = -1
+        # latency machinery (tunneled PJRT backends pay ~70 ms per blocking
+        # launch+readback; compiles cost seconds):
+        # - one RLock serializes every reader/writer of the observation
+        #   buffers, the PRNG stream, and the prefetch pool — interleavings
+        #   of the refill thread and the caller can't diverge the stream
+        # - _warmup fires on the first random-phase suggest: the EI kernel
+        #   for the first post-initial-points shape compiles in the
+        #   background while the initial random trials run
+        # - observe() fires a speculative pool refill once EI is active, so
+        #   the next suggest() finds its points already computed (or at
+        #   least the launch already in flight)
+        self._kernel_lock = threading.RLock()
+        self._warmup_started = False
+        self._warmup_thread: Optional[threading.Thread] = None
+        self._refill_thread: Optional[threading.Thread] = None
+        self._ei_active = False
 
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:
         self._X.append(self.cube.transform(trial.params))
         self._y.append(float(trial.objective))
 
+    def observe(self, trials: List[Trial]) -> None:
+        with self._kernel_lock:
+            super().observe(trials)
+        self._maybe_refill_async()
+
     # -- suggest -----------------------------------------------------------
     def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
-        if len(self._y) < self.n_initial_points:
-            return [self.space.sample(1, seed=self.rng)[0] for _ in range(num)]
-        return self._suggest_ei(num)
+        with self._kernel_lock:
+            if len(self._y) < self.n_initial_points:
+                self._maybe_warmup_async()
+                return [self.space.sample(1, seed=self.rng)[0]
+                        for _ in range(num)]
+            return self._suggest_ei(num)
+
+    # -- background compile / speculative refill ---------------------------
+    def _maybe_warmup_async(self) -> None:
+        """Compile the EI kernel while the initial random trials run.
+
+        The first post-``n_initial_points`` suggest otherwise pays the whole
+        XLA compile (seconds) inline. The warmup compiles exactly the padded
+        variant that first suggest will use — pure function, instance state
+        untouched — so by the time the initial trials finish the kernel is
+        hot (and, with JAX_COMPILATION_CACHE_DIR set, persisted for every
+        other worker process too).
+        """
+        if self._warmup_started:
+            return
+        self._warmup_started = True
+        npad = pad_pow2(self.n_initial_points + 1)
+        n_out = pad_pow2(self.pool_prefetch, minimum=1)
+        d = self.cube.n_dims
+        n_choices = self.cube.n_choices.astype(np.int32)
+        cont = ~self.cube.categorical_mask
+
+        def work() -> None:
+            try:
+                tpe_suggest_fused(
+                    jnp.full((npad, d), 0.5, jnp.float32),
+                    jnp.full((npad,), jnp.inf, jnp.float32)
+                    .at[: self.n_initial_points]
+                    .set(jnp.arange(self.n_initial_points, dtype=jnp.float32)),
+                    self.n_initial_points, 0, jax.random.PRNGKey(0),
+                    jnp.asarray(n_choices), jnp.asarray(cont),
+                    self.gamma, self.prior_weight, self.full_weight_num,
+                    n_cand=self.n_ei_candidates, n_out=n_out,
+                    kmax=self._kmax, equal_weight=self.equal_weight,
+                ).block_until_ready()
+            except Exception as exc:  # warmup is best-effort
+                logging.getLogger(__name__).debug("tpe warmup failed: %s", exc)
+
+        self._warmup_thread = threading.Thread(
+            target=work, name="tpe-warmup", daemon=True
+        )
+        self._warmup_thread.start()
+
+    def _maybe_refill_async(self) -> None:
+        """Start computing the next pool the moment the fit changes.
+
+        Fires after ``observe()`` once EI suggesting is active: the worker
+        spends its inter-trial time on ledger RPCs and subprocess teardown,
+        which is exactly the window the kernel launch + readback (~70 ms on
+        a tunneled backend) can hide in. The refill holds the kernel lock,
+        so a concurrent ``suggest()`` simply waits for the fresh pool
+        instead of racing it; either interleaving serves the same points
+        from the same PRNG stream position.
+        """
+        if not self._ei_active or len(self._y) < self.n_initial_points:
+            return
+        if self._refill_thread is not None and self._refill_thread.is_alive():
+            return
+
+        def work() -> None:
+            try:
+                with self._kernel_lock:
+                    if (self._prefetch_n_obs != len(self._y)
+                            or not self._prefetch):
+                        self._refill_pool()
+            except Exception as exc:  # next suggest() will retry inline
+                logging.getLogger(__name__).debug("tpe refill failed: %s", exc)
+
+        self._refill_thread = threading.Thread(
+            target=work, name="tpe-refill", daemon=True
+        )
+        self._refill_thread.start()
+
+    def _refill_pool(self) -> None:
+        """One uniform pool-width launch appended to the prefetch (locked).
+
+        Launches are ALWAYS ``pool_prefetch`` wide: a single compiled n_out
+        variant serves every call pattern, and any interleaving of refill
+        thread and caller produces the identical suggestion stream (same
+        widths, same ``count`` order).
+        """
+        if self._prefetch_n_obs != len(self._y):
+            self._prefetch = []
+            self._prefetch_n_obs = len(self._y)
+        self._prefetch.extend(self._launch_ei(self.pool_prefetch))
 
     def _split(self) -> Tuple[np.ndarray, np.ndarray]:
         """Indices of good (below) / bad (above) observations."""
@@ -237,23 +347,26 @@ class TPE(BaseAlgorithm):
         return self._suggest_ei(1)[0]
 
     def _suggest_ei(self, num: int) -> List[Dict[str, Any]]:
-        """Serve from the prefetch batch; refill with one kernel launch.
+        """Serve from the prefetch pool; refill in uniform launches.
 
         The fused kernel's cost is dominated by launch + blocking D2H
         readback, not by the pool width (pooled vs single was 9ms vs 72ms
-        per point on the v5e) — so always compute ``max(num,
-        pool_prefetch)`` points per launch and serve later calls from the
-        leftovers while the fit is unchanged.
+        per point on the v5e) — so points are computed ``pool_prefetch`` at
+        a time and later calls are served from the leftovers while the fit
+        is unchanged. When ``observe()``'s speculative refill already ran
+        (or is in flight — it holds the kernel lock), this serves without
+        touching the device at all.
         """
-        if self._prefetch_n_obs == len(self._y) and len(self._prefetch) >= num:
-            out, self._prefetch = self._prefetch[:num], self._prefetch[num:]
+        with self._kernel_lock:
+            self._ei_active = True
+            if self._prefetch_n_obs != len(self._y):
+                self._prefetch = []
+                self._prefetch_n_obs = len(self._y)
+            while len(self._prefetch) < num:
+                self._refill_pool()
+            out = self._prefetch[:num]
+            self._prefetch = self._prefetch[num:]
             return out
-        batch = max(num, self.pool_prefetch)
-        points = self._launch_ei(batch)
-        out, rest = points[:num], points[num:]
-        self._prefetch = rest
-        self._prefetch_n_obs = len(self._y)
-        return out
 
     def _launch_ei(self, num: int) -> List[Dict[str, Any]]:
         """One kernel launch + one readback for the whole pool of ``num``."""
@@ -288,6 +401,10 @@ class TPE(BaseAlgorithm):
 
     def score(self, point: Dict[str, Any]) -> float:
         """EI score of an arbitrary point under the current l/g fit."""
+        with self._kernel_lock:
+            return self._score_locked(point)
+
+    def _score_locked(self, point: Dict[str, Any]) -> float:
         if len(self._y) < max(2, self.n_initial_points):
             return 0.0
         below, above = self._split()
@@ -307,31 +424,34 @@ class TPE(BaseAlgorithm):
 
     def seed_rng(self, seed: Optional[int]) -> None:
         super().seed_rng(seed)
-        self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
-        self._base_key = None
-        self._suggest_count = 0
-        self._prefetch = []
-        self._prefetch_n_obs = -1
+        with getattr(self, "_kernel_lock", threading.RLock()):
+            self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
+            self._base_key = None
+            self._suggest_count = 0
+            self._prefetch = []
+            self._prefetch_n_obs = -1
 
     # -- persistence -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
-        s = super().state_dict()
-        s["X"] = [x.tolist() for x in self._X]
-        s["y"] = list(self._y)
-        s["suggest_count"] = self._suggest_count
-        # unserved prefetched points travel with the state: a restored
-        # instance must continue the exact suggestion stream, not skip the
-        # tail of the batch the live instance had already launched
-        s["prefetch"] = [dict(p) for p in self._prefetch]
-        s["prefetch_n_obs"] = self._prefetch_n_obs
-        return s
+        with self._kernel_lock:  # waits out an in-flight speculative refill
+            s = super().state_dict()
+            s["X"] = [x.tolist() for x in self._X]
+            s["y"] = list(self._y)
+            s["suggest_count"] = self._suggest_count
+            # unserved prefetched points travel with the state: a restored
+            # instance must continue the exact suggestion stream, not skip
+            # the tail of the batch the live instance had already launched
+            s["prefetch"] = [dict(p) for p in self._prefetch]
+            s["prefetch_n_obs"] = self._prefetch_n_obs
+            return s
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
-        super().load_state_dict(state)
-        self._X = [np.asarray(x, np.float32) for x in state.get("X", [])]
-        self._y = list(state.get("y", []))
-        self._suggest_count = int(state.get("suggest_count", 0))
-        self._cap = 0          # invalidate device mirror
-        self._n_dev = -1
-        self._prefetch = [dict(p) for p in state.get("prefetch", [])]
-        self._prefetch_n_obs = int(state.get("prefetch_n_obs", -1))
+        with self._kernel_lock:
+            super().load_state_dict(state)
+            self._X = [np.asarray(x, np.float32) for x in state.get("X", [])]
+            self._y = list(state.get("y", []))
+            self._suggest_count = int(state.get("suggest_count", 0))
+            self._cap = 0          # invalidate device mirror
+            self._n_dev = -1
+            self._prefetch = [dict(p) for p in state.get("prefetch", [])]
+            self._prefetch_n_obs = int(state.get("prefetch_n_obs", -1))

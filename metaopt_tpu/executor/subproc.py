@@ -71,6 +71,13 @@ class SubprocessExecutor(Executor):
             self.extra_env.setdefault(
                 "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1"
             )
+            # the PRODUCER process compiles too (the TPE suggest kernel):
+            # share the same cache so a worker restart — or the N-th
+            # parallel worker — skips the first-suggest compile stall
+            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+            os.environ.setdefault(
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1"
+            )
 
     # -- env/argv assembly -------------------------------------------------
     def _prepare(self, trial: Trial, tmpdir: str) -> tuple[List[str], Dict[str, str], str]:

@@ -180,3 +180,48 @@ class TestSuggest:
         good = tpe.score({"x": -8.0, "c": "a"})
         bad = tpe.score({"x": 9.0, "c": "a"})
         assert good > bad
+
+
+class TestAsyncLatencyMachinery:
+    def test_speculative_refill_matches_inline_stream(self):
+        # observe() fires a background pool refill; any interleaving with
+        # suggest() must serve the IDENTICAL suggestion stream that a
+        # refill-disabled instance computes inline
+        space, eager = make_tpe(seed=11)
+        _, lazy = make_tpe(seed=11)
+        lazy._maybe_refill_async = lambda: None  # disable speculation
+        trials = [completed(space, {"x": float(i), "c": "a"}, float(i))
+                  for i in range(6)]
+        for algo in (eager, lazy):
+            algo.suggest(1)            # enter EI-active state identically
+            algo.observe(trials)
+        t = eager._refill_thread
+        if t is not None:
+            t.join(timeout=60)
+        assert eager.suggest(3) == lazy.suggest(3)
+        # and the streams stay aligned across a second fit change
+        more = [completed(space, {"x": -5.0, "c": "b"}, -1.0)]
+        eager.observe(more)
+        lazy.observe(more)
+        assert eager.suggest(2) == lazy.suggest(2)
+
+    def test_warmup_thread_has_no_side_effects(self):
+        space, tpe = make_tpe(seed=3)
+        before = tpe.state_dict()
+        tpe.suggest(1)  # random phase: triggers the background compile
+        assert tpe._warmup_thread is not None
+        tpe._warmup_thread.join(timeout=120)
+        after = tpe.state_dict()
+        # warmup must not advance the PRNG stream or touch observations
+        assert after["suggest_count"] == before["suggest_count"] == 0
+        assert after["X"] == before["X"]
+
+    def test_uniform_launch_width_beyond_pool(self):
+        # asking for more points than pool_prefetch chains uniform launches
+        space, tpe = make_tpe(seed=9, pool_prefetch=4)
+        for i in range(6):
+            tpe.observe([completed(space, {"x": float(i), "c": "a"}, float(i))])
+        pts = tpe.suggest(10)  # 3 launches of 4, serve 10, keep 2
+        assert len(pts) == 10
+        assert len(tpe._prefetch) == 2
+        assert len({space.hash_point(p) for p in pts}) > 1
