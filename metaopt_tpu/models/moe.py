@@ -1,17 +1,34 @@
 """Mixture-of-Experts feed-forward with expert parallelism ("ep").
 
-Switch-style top-1 routing (Fedus et al.; see PAPERS.md): a router picks
-one expert per token, tokens are dispatched with a one-hot combine so the
-whole layer stays dense einsums — XLA partitions the expert axis over the
-"ep" mesh dimension (expert weights are sharded E/ep per chip via
-``nn.with_partitioning``) and inserts the dispatch/return collectives
-itself, the GSPMD analogue of the hand-written all_to_all in
-CUDA-era MoE stacks. Inside each expert the hidden dim still splits over
-"tp", so ep composes with the Megatron split.
+Switch-style top-1 routing (Fedus et al.; see PAPERS.md) with
+**capacity-bounded dispatch**: each expert processes at most
+``capacity = ceil(capacity_factor · tokens / n_experts)`` tokens per step.
+Kept tokens are scattered into per-expert slabs of that static shape, the
+expert FFNs run as batched einsums over ``(E, capacity, d)``, and results
+gather back to token order — so FLOPs scale with the *token* count
+(``E · capacity ≈ capacity_factor · T``), not with ``E × T`` like a dense
+all-experts dispatch. Tokens that overflow an expert's queue are dropped
+for the layer (their FFN output is zero; the transformer's residual
+connection carries them through unchanged — standard Switch behavior) and
+counted in the ``"moe_stats"`` collection.
+
+Everything is static-shaped for XLA: capacity comes from the (static)
+token count, queue positions are a cumsum over token order, and
+drop-vs-keep is a branchless scatter to an overflow slot that is sliced
+away. Expert weights shard E/ep per chip via ``nn.with_partitioning``;
+GSPMD inserts the token-shuffle collectives around the scatter/gather, the
+analogue of the hand-written all_to_all in CUDA-era MoE stacks. Inside
+each expert the hidden dim still splits over "tp", so ep composes with the
+Megatron split.
 
 The router adds the standard switch load-balancing auxiliary loss
 (``n_experts · Σ_e fraction_e · mean_prob_e``), surfaced through the
-module's ``"aux_loss"`` collection so the train step can weigh it in.
+module's ``"aux_loss"`` collection so the train step can weigh it in; the
+dropped-token fraction rides the ``"moe_stats"`` collection the same way.
+
+``capacity_factor <= 0`` selects the dense all-experts dispatch — O(E·T)
+compute, no dropping — kept as the numerics oracle the capacity path is
+tested against.
 
 ref: the reference framework has no model code (SURVEY.md §2.8) — this is
 demo-zoo surface, here so trials can exercise expert-parallel shardings
@@ -19,6 +36,8 @@ on gang-scheduled sub-slices.
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +49,8 @@ class MoEFeedForward(nn.Module):
     d_ff: int
     n_experts: int
     dropout: float = 0.0
+    #: per-expert queue length = capacity_factor · T / E; <= 0 = dense oracle
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, *, train: bool):
@@ -63,14 +84,52 @@ class MoEFeedForward(nn.Module):
         self.sow("aux_loss", "moe_balance",
                  e * jnp.sum(frac * mean_prob))
 
-        # dense dispatch: (E, b, s, d) masked token copies. Fine at
-        # demo expert counts; GSPMD shards the E axis over "ep" so each
-        # chip materializes only E/ep expert slabs
-        xe = jnp.einsum("bse,bsd->ebsd", onehot, x.astype(jnp.float32))
+        dropout = nn.Dropout(self.dropout, deterministic=not train)
+
+        if self.capacity_factor <= 0:
+            # dense all-experts oracle: (E, b, s, d) masked token copies —
+            # E× the useful FLOPs, but exact (nothing dropped)
+            xe = jnp.einsum("bse,bsd->ebsd", onehot, x.astype(jnp.float32))
+            h = nn.relu(jnp.einsum(
+                "ebsd,edf->ebsf",
+                xe.astype(jnp.bfloat16), wi.astype(jnp.bfloat16)
+            ))
+            h = dropout(h)
+            ye = jnp.einsum("ebsf,efd->ebsd", h, wo.astype(jnp.bfloat16))
+            y = jnp.einsum("ebsd,bse->bsd", ye.astype(jnp.float32), onehot)
+            return (y * gate[..., None]).astype(x.dtype)
+
+        # ---- capacity-bounded scatter/gather dispatch ----
+        t = b * s
+        cap = max(1, int(math.ceil(self.capacity_factor * t / e)))
+        xf = x.reshape(t, d)
+        topf = top.reshape(t)
+        # queue position of each token within its expert, in token order
+        ohf = onehot.reshape(t, e)
+        pos_all = jnp.cumsum(ohf, axis=0) - 1.0           # (t, E)
+        pos = jnp.take_along_axis(
+            pos_all, topf[:, None], axis=1
+        )[:, 0].astype(jnp.int32)                         # (t,)
+        kept = pos < cap
+        self.sow("moe_stats", "dropped_fraction",
+                 1.0 - jnp.mean(kept.astype(jnp.float32)))
+
+        # branchless scatter: overflowing tokens land in slot `cap`, which
+        # is sliced away; kept (expert, slot) pairs are unique by cumsum
+        dst = jnp.where(kept, pos, cap)                   # (t,)
+        buf = jnp.zeros((e, cap + 1, d), x.dtype)
+        expert_in = buf.at[topf, dst].set(xf)[:, :cap]    # (E, cap, d)
+
         h = nn.relu(jnp.einsum(
-            "ebsd,edf->ebsf", xe.astype(jnp.bfloat16), wi.astype(jnp.bfloat16)
+            "ecd,edf->ecf",
+            expert_in.astype(jnp.bfloat16), wi.astype(jnp.bfloat16)
         ))
-        h = nn.Dropout(self.dropout, deterministic=not train)(h)
-        ye = jnp.einsum("ebsf,efd->ebsd", h, wo.astype(jnp.bfloat16))
-        y = jnp.einsum("ebsd,bse->bsd", ye.astype(jnp.float32), onehot)
-        return (y * gate[..., None]).astype(x.dtype)
+        h = dropout(h)
+        out = jnp.einsum("ecf,efd->ecd", h, wo.astype(jnp.bfloat16))
+
+        # gather back to token order; dropped tokens contribute zero (the
+        # caller's residual connection carries them through)
+        y = out[topf, jnp.minimum(dst, cap - 1)].astype(jnp.float32)
+        y = jnp.where(kept[:, None], y, 0.0)
+        y = (y * gate.reshape(t)[:, None]).reshape(b, s, d)
+        return y.astype(x.dtype)

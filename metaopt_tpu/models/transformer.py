@@ -137,11 +137,12 @@ class FeedForward(nn.Module):
         return wo(h)
 
 
-def _make_mlp(d_model, d_ff, dropout, n_experts):
+def _make_mlp(d_model, d_ff, dropout, n_experts, capacity_factor=1.25):
     if n_experts > 0:
         from metaopt_tpu.models.moe import MoEFeedForward
 
-        return MoEFeedForward(d_model, d_ff, n_experts, dropout, name="mlp")
+        return MoEFeedForward(d_model, d_ff, n_experts, dropout,
+                              capacity_factor, name="mlp")
     return FeedForward(d_model, d_ff, dropout, name="mlp")
 
 
@@ -151,6 +152,7 @@ class EncoderLayer(nn.Module):
     d_ff: int
     dropout: float
     n_experts: int = 0
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, pad_mask, *, train: bool):
@@ -160,7 +162,7 @@ class EncoderLayer(nn.Module):
                     name="self_attn")(y, y, pad_mask, train=train)
         y = ln("ln2")(x)
         x = x + _make_mlp(self.d_model, self.d_ff, self.dropout,
-                          self.n_experts)(y, train=train)
+                          self.n_experts, self.capacity_factor)(y, train=train)
         return x
 
 
@@ -170,6 +172,7 @@ class DecoderLayer(nn.Module):
     d_ff: int
     dropout: float
     n_experts: int = 0
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, enc, causal_mask, cross_mask, *, train: bool):
@@ -182,7 +185,7 @@ class DecoderLayer(nn.Module):
                     name="cross_attn")(y, enc, cross_mask, train=train)
         y = ln("ln3")(x)
         x = x + _make_mlp(self.d_model, self.d_ff, self.dropout,
-                          self.n_experts)(y, train=train)
+                          self.n_experts, self.capacity_factor)(y, train=train)
         return x
 
 
@@ -199,6 +202,8 @@ class Transformer(nn.Module):
     #: >0 turns every FFN into a top-1-routed MoE with this many experts
     #: (weights sharded over the "ep" mesh axis when present)
     n_experts: int = 0
+    #: per-expert queue = capacity_factor*T/E tokens; <=0 = dense dispatch
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, src, tgt_in, *, train: bool):
@@ -224,13 +229,15 @@ class Transformer(nn.Module):
         for i in range(self.n_layers):
             x = EncoderLayer(self.d_model, self.n_heads, self.d_ff,
                              self.dropout, self.n_experts,
+                             self.capacity_factor,
                              name=f"enc{i}")(x, src_pad, train=train)
         enc = nn.LayerNorm(dtype=jnp.float32, name="enc_ln")(x).astype(jnp.bfloat16)
 
         y = emb(tgt_in) + pos[None, :t_len].astype(jnp.bfloat16)
         for i in range(self.n_layers):
             y = DecoderLayer(self.d_model, self.n_heads, self.d_ff,
-                             self.dropout, self.n_experts, name=f"dec{i}")(
+                             self.dropout, self.n_experts,
+                             self.capacity_factor, name=f"dec{i}")(
                 y, enc, causal_mask, cross_mask, train=train
             )
         y = nn.LayerNorm(dtype=jnp.float32, name="dec_ln")(y)
@@ -255,6 +262,7 @@ def make_model(hparams: Optional[Dict[str, Any]] = None, **overrides) -> Transfo
         d_ff=int(h.get("d_ff", 2048)),
         dropout=float(h.get("dropout", 0.1)),
         n_experts=int(h.get("n_experts", 0)),
+        capacity_factor=float(h.get("capacity_factor", 1.25)),
     )
 
 
