@@ -1,16 +1,35 @@
-"""Pipeline parallelism: a GPipe schedule over a "pp" mesh axis.
+"""Pipeline parallelism: GPipe and interleaved virtual-stage schedules.
 
-Each device along the axis holds ONE stage's parameters (a pytree with a
-leading stage dimension, sharded over "pp"). Microbatches flow stage to
-stage over the ICI ring: every tick, each stage applies its function to
-the activation it holds and passes the result one hop with
-``lax.ppermute``. A batch of M microbatches through P stages takes
-M + P − 1 ticks (the usual GPipe bubble); activations live one microbatch
-per stage, so per-chip activation memory is O(microbatch), not O(batch).
+Each device along the "pp" mesh axis holds ``virtual_stages`` stage slices
+(a pytree with a leading logical-stage dimension of size P·V, laid out so
+device d owns stages d, P+d, 2P+d, …). Microbatches flow stage to stage
+over the ICI ring: every tick, each device applies ONE stage slice to the
+activation it holds and hands the result one hop with ``lax.ppermute``
+(wraparound ring — the hop from device P−1 back to device 0 carries the
+activation into its next virtual round, and the timing works out to
+single-tick hops with no buffering).
+
+Schedules, for M microbatches over P devices:
+
+- ``virtual_stages=1`` — plain GPipe: M + P − 1 ticks, bubble fraction
+  (P−1)/(M+P−1).
+- ``virtual_stages=V>1`` — the interleaved schedule (Megatron-style
+  virtual pipeline): each tick does 1/V of a device's work, M·V + P − 1
+  ticks total, bubble fraction **(P−1)/(M·V+P−1)** — strictly smaller
+  than GPipe's at the same M. See :func:`bubble_fraction`.
+
+Non-shape-preserving ends ride along: ``pre_fn`` (e.g. token embedding)
+runs as part of logical stage 0 on each fed microbatch, ``post_fn`` (e.g.
+the logits readout) on the last stage's collected outputs — so a real
+embed → blocks → readout transformer maps onto the pipe even though its
+end shapes differ from the trunk activations.
 
 Everything is ``lax.scan`` + ``ppermute`` + one final masked ``psum``, so
 ``jax.grad`` differentiates it into the reverse pipeline schedule
-automatically — no bespoke backward.
+automatically — no bespoke backward. (The backward therefore runs after
+the full forward, GPipe-style: this buys the interleaved schedule's
+bubble, not 1F1B's O(P) activation memory; activations are O(M·V) per
+device as in any scan-VJP pipeline.)
 
 ref: the reference framework has no parallelism layers at all (SURVEY.md
 §2.8); this is TPU-native demo-zoo surface so trials can shard deep
@@ -21,11 +40,21 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metaopt_tpu.ops.attention import shard_map_nocheck
+
+
+def bubble_fraction(n_stages: int, n_microbatches: int,
+                    virtual_stages: int = 1) -> float:
+    """Idle fraction of the schedule: (P−1)/(M·V + P − 1)."""
+    return (n_stages - 1) / (
+        n_microbatches * virtual_stages + n_stages - 1
+    )
 
 
 def pipeline_apply(
@@ -37,30 +66,48 @@ def pipeline_apply(
     axis: str = "pp",
     batch_axis: Optional[str] = "dp",
     n_microbatches: Optional[int] = None,
+    virtual_stages: int = 1,
+    pre_fn: Optional[Callable[[Any, jnp.ndarray], jnp.ndarray]] = None,
+    pre_params: Any = None,
+    post_fn: Optional[Callable[[Any, jnp.ndarray], jnp.ndarray]] = None,
+    post_params: Any = None,
 ) -> jnp.ndarray:
-    """y = stage_{P-1}(…stage_1(stage_0(x))) with stages sharded over pp.
+    """y = post(stage_{PV-1}(…stage_0(pre(x)))) with stages sharded over pp.
 
     ``stage_params``: pytree whose leaves have a leading dimension of size
-    P (one slice per stage), sharded over ``axis``. ``stage_fn(params_p,
-    h) -> h`` must be shape-preserving (same activation shape in and out).
-    ``x``: (B, ...) batch, optionally sharded over ``batch_axis``; the
-    per-shard batch must be a multiple of ``n_microbatches`` (default P).
-    Returns y shaped like x.
+    P·``virtual_stages`` (one slice per logical stage, device d owning
+    logical stages v·P+d), sharded over ``axis``. ``stage_fn(params_s, h)
+    -> h`` must be shape-preserving on the trunk activation; ``pre_fn``
+    /``post_fn`` map into/out of that shape at the pipe's ends (their
+    params are replicated). ``x``: (B, ...) batch, optionally sharded over
+    ``batch_axis``; the per-shard batch must be a multiple of
+    ``n_microbatches`` (default P), and ``n_microbatches`` a multiple of P
+    when ``virtual_stages > 1`` (the interleaved schedule feeds in groups
+    of P). Returns y shaped like ``post_fn``'s output (or like x).
     """
     if axis not in mesh.shape:
         raise ValueError(f"mesh has no {axis!r} axis: {dict(mesh.shape)}")
     n_stages = mesh.shape[axis]
+    v_stages = int(virtual_stages)
+    n_logical = n_stages * v_stages
     leads = {jnp.shape(leaf)[0] if jnp.ndim(leaf) else None
              for leaf in jax.tree.leaves(stage_params)}
-    if leads != {n_stages}:
-        # a[0] below keeps exactly one stage per device; any other leading
-        # dim would silently drop stages and return wrong numbers
+    if leads != {n_logical}:
+        # reshaping below assumes exactly one slice per logical stage; any
+        # other leading dim would silently drop stages and return wrong
+        # numbers
         raise ValueError(
             f"stage_params leading dims {sorted(leads, key=str)} must all "
-            f"equal the {axis} mesh size {n_stages} (None = scalar leaf)"
+            f"equal {axis} mesh size × virtual_stages = {n_logical} "
+            "(None = scalar leaf)"
         )
     ab = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
     m = n_microbatches or n_stages
+    if v_stages > 1 and m % n_stages:
+        raise ValueError(
+            f"interleaved schedule feeds microbatches in groups of "
+            f"{n_stages}: n_microbatches {m} must be a multiple"
+        )
     b_local = x.shape[0] // (mesh.shape[ab] if ab else 1)
     if b_local % m:
         raise ValueError(
@@ -68,43 +115,81 @@ def pipeline_apply(
             f"n_microbatches {m}"
         )
 
-    param_specs = jax.tree.map(lambda _: P(axis), stage_params)
+    # device d owns logical stages v·P + d: reshape (PV, ...) -> (V, P, ...)
+    # and shard the SECOND axis over pp, so the local slice is (V, ...)
+    stacked = jax.tree.map(
+        lambda a: jnp.reshape(a, (v_stages, n_stages) + a.shape[1:]),
+        stage_params,
+    )
+    param_specs = jax.tree.map(lambda _: P(None, axis), stacked)
     xs = P(ab, *([None] * (x.ndim - 1)))
+    rep = jax.tree.map(lambda _: P(), (pre_params, post_params))
 
-    def local(params, x_loc):
-        # params leaves: (1, ...) — this device's stage slice
-        params_p = jax.tree.map(lambda a: a[0], params)
+    ticks = m * v_stages + n_stages - 1
+    ring = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+    # the last device finishes chunk (g, V−1, j) of microbatch g·P+j at
+    # tick (P−1) + g·V·P + (V−1)·P + j — static schedule, so the gather
+    # indices are host-side numpy
+    g_idx = np.arange(m // n_stages if v_stages > 1 else m)
+    if v_stages > 1:
+        t_out = (n_stages - 1 + (v_stages - 1) * n_stages
+                 + g_idx[:, None] * v_stages * n_stages
+                 + np.arange(n_stages)[None, :]).reshape(-1)
+    else:
+        t_out = n_stages - 1 + np.arange(m)
+
+    def local(params, x_loc, pre_p, post_p):
+        # params leaves: (V, 1, ...) — this device's V stage slices
+        params_v = jax.tree.map(lambda a: a[:, 0], params)
         p_idx = jax.lax.axis_index(axis)
         micro = x_loc.reshape(m, x_loc.shape[0] // m, *x_loc.shape[1:])
-        ticks = m + n_stages - 1
-        fwd = [(j, j + 1) for j in range(n_stages - 1)]  # no wraparound
+
+        def embed(mb):
+            return pre_fn(pre_p, mb) if pre_fn is not None else mb
+
+        h_shape = jax.eval_shape(embed, micro[0])
 
         def tick(carry, t):
-            held = carry  # activation this stage holds entering tick t
-            # stage 0 feeds itself from the microbatch queue (zeros once
-            # the queue is drained — those bubbles are masked out below)
-            feed = jax.lax.dynamic_index_in_dim(
-                micro, jnp.minimum(t, m - 1), keepdims=False
-            ) * (t < m)
-            inp = jnp.where(p_idx == 0, feed, held)
-            out = stage_fn(params_p, inp)
-            # hand the result one hop down the pipe; stage 0 receives
-            # nothing (zeros), the last stage's send is its output
-            nxt = jax.lax.ppermute(out, axis, fwd)
+            held = carry  # activation this device holds entering tick t
+            # static interleaved schedule: device d works on chunk
+            # (g, v, j) = microbatch g·P+j at virtual round v, where
+            # t = d + g·V·P + v·P + j — invert per tick
+            lt = jnp.clip(t - p_idx, 0, m * v_stages - 1)
+            r = lt % (v_stages * n_stages)
+            v = r // n_stages
+            g = lt // (v_stages * n_stages)
+            micro_idx = g * n_stages + (r % n_stages)
+            feed = embed(jax.lax.dynamic_index_in_dim(
+                micro, micro_idx, keepdims=False
+            ))
+            inp = jnp.where((p_idx == 0) & (v == 0), feed, held)
+            p_v = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, v, keepdims=False
+                ),
+                params_v,
+            )
+            out = stage_fn(p_v, inp)
+            # one hop down the wraparound ring: the (P−1)→0 edge carries
+            # the chunk into its next virtual round, arriving exactly one
+            # tick later — no buffering
+            nxt = jax.lax.ppermute(out, axis, ring)
             return nxt, out
 
-        h0 = jnp.zeros_like(micro[0])
+        h0 = jnp.zeros(h_shape.shape, h_shape.dtype)
         _, outs = jax.lax.scan(tick, h0, jnp.arange(ticks))
-        # the last stage emitted microbatch (t - P + 1) at tick t: ticks
-        # P-1 .. P-1+M-1 hold the M results, in order
-        y_loc = jax.lax.dynamic_slice_in_dim(outs, n_stages - 1, m, axis=0)
-        y_loc = y_loc.reshape(x_loc.shape)
-        # only the last stage holds real outputs; broadcast them across
+        y_loc = outs[jnp.asarray(t_out)]        # (M, mb, ...) in micro order
+        y_loc = y_loc.reshape((x_loc.shape[0],) + y_loc.shape[2:])
+        if post_fn is not None:
+            y_loc = post_fn(post_p, y_loc)
+        # only the last device holds real outputs; broadcast them across
         # the pp axis so every shard returns the same (replicated) y
         y_loc = jnp.where(p_idx == n_stages - 1, y_loc, 0.0)
         return jax.lax.psum(y_loc, axis)
 
     wrapped = shard_map_nocheck(
-        local, mesh, in_specs=(param_specs, xs), out_specs=xs
+        local, mesh,
+        in_specs=(param_specs, xs, rep[0], rep[1]),
+        out_specs=xs,
     )
-    return wrapped(stage_params, x)
+    return wrapped(stacked, x, pre_params, post_params)
