@@ -154,6 +154,31 @@ def _kill_by_env_marker(marker: str) -> int:
     return killed
 
 
+def _partial_progress(ledger_path: str, name: str, wall_s: float) -> dict:
+    """What a timed-out config DID finish, read straight off its ledger.
+
+    A timeout line with no numbers hides whether the config was 90% done
+    or wedged at trial 1 — the difference between "raise the cap" and
+    "debug the compile path".
+    """
+    try:
+        from metaopt_tpu.ledger.backends import make_ledger
+
+        ledger = make_ledger({"type": "file", "path": ledger_path})
+        completed = ledger.count(name, "completed")
+        return {
+            "partial_completed": completed,
+            "partial_trials_per_hour": round(3600 * completed / wall_s, 1),
+            "partial_statuses": {
+                s: ledger.count(name, s)
+                for s in ("reserved", "suspended", "broken", "new")
+                if ledger.count(name, s)
+            },
+        }
+    except Exception as exc:  # diagnostics must never mask the timeout
+        return {"partial_error": str(exc)[:120]}
+
+
 def run_config(name: str, spec: dict, scale: str, ledger_root: str,
                backend: str, config_timeout_s: float) -> dict:
     max_trials = spec["max_trials"][scale]
@@ -208,11 +233,15 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str,
             stdout, stderr = proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
             stdout, stderr = "", "unreapable after kill"
-        return {"config": name, "trials": max_trials,
-                "wall_s": round(time.time() - t0, 1),
-                "backend": "cpu" if on_cpu else backend,
-                "error": f"config timeout ({config_timeout_s:.0f}s); "
-                         f"stderr tail: {stderr[-300:]}"}
+        out = {"config": name, "trials": max_trials,
+               "wall_s": round(time.time() - t0, 1),
+               "backend": "cpu" if on_cpu else backend,
+               "error": f"config timeout ({config_timeout_s:.0f}s); "
+                        f"stderr tail: {stderr[-300:]}"}
+        out.update(_partial_progress(
+            os.path.join(ledger_root, name), name, config_timeout_s
+        ))
+        return out
     wall = time.time() - t0
 
     out = {"config": name, "trials": max_trials, "wall_s": round(wall, 1),

@@ -100,19 +100,31 @@ def train(
     seed: int = 0,
 ) -> float:
     """Run PPO; return NEGATIVE mean episode return (HPO minimizes)."""
+    # every scalar hyperparameter is a TRACED value, not a baked-in Python
+    # constant: all trials of a sweep (same hidden width) then share ONE
+    # XLA program, so the persistent compile cache turns a per-trial
+    # remote compile (~2-3 min through the relay) into a per-sweep one —
+    # the difference between evolution_ppo timing out and finishing
+    hp = {
+        "clip_eps": jnp.float32(hparams.get("clip_eps", 0.2)),
+        "ent_coef": jnp.float32(hparams.get("ent_coef", 0.01)),
+        "vf_coef": jnp.float32(hparams.get("vf_coef", 0.5)),
+        "gamma": jnp.float32(hparams.get("gamma", 0.99)),
+        "lam": jnp.float32(hparams.get("gae_lambda", 0.95)),
+    }
     lr = float(hparams.get("lr", 3e-4))
-    clip_eps = float(hparams.get("clip_eps", 0.2))
-    ent_coef = float(hparams.get("ent_coef", 0.01))
-    vf_coef = float(hparams.get("vf_coef", 0.5))
-    gamma = float(hparams.get("gamma", 0.99))
-    lam = float(hparams.get("gae_lambda", 0.95))
     model = ActorCritic(hidden=int(hparams.get("hidden", 64)))
 
     key = jax.random.PRNGKey(seed)
     key, k_init, k_env = jax.random.split(key, 3)
     env_state, obs = env_reset(k_env, n_envs)
     params = model.init(k_init, obs)
-    tx = optax.chain(optax.clip_by_global_norm(0.5), optax.adam(lr))
+    # inject_hyperparams carries lr inside opt_state as a traced leaf —
+    # the update rule compiles once for any learning rate
+    tx = optax.chain(
+        optax.clip_by_global_norm(0.5),
+        optax.inject_hyperparams(optax.adam)(learning_rate=lr),
+    )
     opt_state = tx.init(params)
 
     def policy_logp(mean, log_std, action):
@@ -131,11 +143,11 @@ def train(
         frame = (obs, action, logp, value, reward, done)
         return (params, env_state, next_obs, key), frame
 
-    def gae(values, rewards, dones, last_value):
+    def gae(values, rewards, dones, last_value, hp):
         def scan_fn(adv, inp):
             v, r, d, v_next = inp
-            delta = r + gamma * v_next * (1 - d) - v
-            adv = delta + gamma * lam * (1 - d) * adv
+            delta = r + hp["gamma"] * v_next * (1 - d) - v
+            adv = delta + hp["gamma"] * hp["lam"] * (1 - d) * adv
             return adv, adv
 
         v_nexts = jnp.concatenate([values[1:], last_value[None]], 0)
@@ -146,7 +158,7 @@ def train(
         )
         return advs, advs + values
 
-    def ppo_loss(params, batch):
+    def ppo_loss(params, batch, hp):
         obs, action, logp_old, adv, ret = batch
         mean, log_std, value = model.apply(params, obs)
         logp = policy_logp(mean, log_std, action)
@@ -154,26 +166,26 @@ def train(
         adv_n = (adv - adv.mean()) / (adv.std() + 1e-8)
         pg = -jnp.minimum(
             ratio * adv_n,
-            jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv_n,
+            jnp.clip(ratio, 1 - hp["clip_eps"], 1 + hp["clip_eps"]) * adv_n,
         ).mean()
         vloss = jnp.mean((value - ret) ** 2)
         entropy = jnp.sum(log_std + 0.5 * jnp.log(2 * np.pi * np.e))
-        return pg + vf_coef * vloss - ent_coef * entropy
+        return pg + hp["vf_coef"] * vloss - hp["ent_coef"] * entropy
 
     @jax.jit
-    def iteration(params, opt_state, env_state, obs, key):
+    def iteration(params, opt_state, env_state, obs, key, hp):
         (params, env_state, obs, key), frames = jax.lax.scan(
             rollout, (params, env_state, obs, key), None, length=rollout_len
         )
         f_obs, f_act, f_logp, f_val, f_rew, f_done = frames
         _, _, last_value = model.apply(params, obs)
-        advs, rets = gae(f_val, f_rew, f_done, last_value)
+        advs, rets = gae(f_val, f_rew, f_done, last_value, hp)
         flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
         batch = (flat(f_obs), flat(f_act), flat(f_logp), flat(advs), flat(rets))
 
         def epoch(carry, _):
             params, opt_state = carry
-            loss, grads = jax.value_and_grad(ppo_loss)(params, batch)
+            loss, grads = jax.value_and_grad(ppo_loss)(params, batch, hp)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), loss
@@ -187,7 +199,7 @@ def train(
     mean_return = jnp.asarray(0.0)
     for _ in range(int(iterations)):
         params, opt_state, env_state, obs, key, mean_return = iteration(
-            params, opt_state, env_state, obs, key
+            params, opt_state, env_state, obs, key, hp
         )
     return float(-mean_return)
 

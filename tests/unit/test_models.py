@@ -200,6 +200,40 @@ class TestPPO:
         v = obj({"lr": 1e-3, "epochs": 2})
         assert np.isfinite(v)
 
+    def test_trials_share_one_compiled_program(self, tmp_path):
+        """Different (lr, clip_eps, ent_coef, gae_lambda) trials must hit
+        the SAME persistent-cache entries: hyperparameters are traced
+        values, not baked-in constants. Proven across real processes: the
+        second trial must add ZERO new entries to the compile cache the
+        first trial populated (a recompile would store a new program)."""
+        import os
+        import subprocess
+        import sys
+
+        cache = str(tmp_path / "xla-cache")
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            JAX_COMPILATION_CACHE_DIR=cache,
+            JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        code = (
+            "from metaopt_tpu.models.ppo import train;"
+            "print(train({{'lr': {lr}, 'clip_eps': {ce}, 'ent_coef': {ec},"
+            "'gae_lambda': {gl}}}, iterations=1, n_envs=8, rollout_len=8,"
+            "ppo_epochs=2))"
+        )
+        def run(**hp):
+            subprocess.check_call([sys.executable, "-c", code.format(**hp)],
+                                  env=env, stdout=subprocess.DEVNULL)
+            return len(os.listdir(cache))
+
+        n1 = run(lr=1e-3, ce=0.1, ec=0.01, gl=0.9)
+        n2 = run(lr=4e-4, ce=0.3, ec=0.05, gl=0.99)
+        assert n1 > 0
+        assert n2 == n1, "second PPO trial compiled new programs"
+
 
 class TestTrialCheckpoint:
     def test_orbax_roundtrip_preserves_sharded_state(self, tmp_path):
