@@ -324,3 +324,31 @@ class TestFullParallelComposition:
                 losses.append(float(loss))
         assert all(l == l and l > 0 for l in losses)
         assert losses[1] < losses[0]  # it actually trains
+
+
+class TestRemat:
+    def test_remat_matches_plain_forward_and_trains(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from metaopt_tpu.models.transformer import (
+            loss_fn, make_model,
+        )
+
+        h = {"d_model": 32, "n_heads": 2, "n_layers": 2, "d_ff": 64,
+             "vocab": 61, "dropout": 0.0}
+        plain = make_model(h)
+        remat = make_model({**h, "remat": True})
+        src = jnp.arange(2 * 8, dtype=jnp.int32).reshape(2, 8) % 60 + 1
+        params = plain.init(jax.random.PRNGKey(0), src, src, train=False)
+        # identical parameter structure: remat is a pure recompute schedule
+        y0 = plain.apply(params, src, src, train=False)
+        y1 = remat.apply(params, src, src, train=False)
+        np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                                   atol=1e-5, rtol=1e-5)
+        # and gradients flow through the rematted backward
+        g = jax.grad(lambda p: loss_fn(
+            remat, p, (src, src), jax.random.PRNGKey(1)
+        ))(params["params"])
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(g))
