@@ -1,0 +1,120 @@
+"""A transformer LM assembled as pipeline stages (pp×dp demo-zoo surface).
+
+The stage trunk is the REAL ``EncoderLayer`` from the demo Transformer —
+self-attention + FFN with the same bf16/f32 mixed precision — stacked
+P·V deep with one parameter slice per logical stage (the scan-over-layers
+layout: every layer shares a structure, so one ``jax.vmap`` over init
+keys builds the stacked pytree). ``pipeline_apply`` runs them under the
+interleaved virtual-stage schedule with the token embedding as ``pre_fn``
+and the vocab readout as ``post_fn`` — the full embed → blocks → logits
+stack mapped onto a pp×dp mesh.
+
+Run it OUTSIDE ``use_mesh``: the pipeline's ``shard_map`` owns the mesh,
+and the layer's MHA must take its single-device path inside each shard
+(an active mesh would make it try to nest another shard_map).
+
+ref: the reference framework has no model code (SURVEY.md §2.8) — this is
+TPU-native demo-zoo surface for pipeline-parallel trials.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from metaopt_tpu.models.transformer import EncoderLayer
+from metaopt_tpu.parallel.pipeline import pipeline_apply
+
+
+def make_pipeline_lm(
+    hparams: Dict[str, Any], n_stages: int, virtual_stages: int = 2,
+    seq: int = 16, seed: int = 0,
+) -> Tuple[Any, Any, Any]:
+    """(stage_fn, pre/post fns, params) for a P·V-layer pipeline LM.
+
+    Returns ``(fns, params)`` where ``fns = (stage_fn, pre_fn, post_fn)``
+    and ``params = (stage_params, pre_params, post_params)`` —
+    ``stage_params`` leaves lead with the logical-stage dimension P·V.
+    """
+    d = int(hparams.get("d_model", 32))
+    n_heads = int(hparams.get("n_heads", 2))
+    d_ff = int(hparams.get("d_ff", 64))
+    vocab = int(hparams.get("vocab", 101))
+    n_layers = n_stages * virtual_stages
+
+    # partitioned=False: the pipeline shard_map owns the mesh; a tp spec
+    # inside it would be rejected, not pruned
+    layer = EncoderLayer(d, n_heads, d_ff, dropout=0.0, partitioned=False)
+    key = jax.random.PRNGKey(seed)
+    k_emb, k_pos, k_ro, k_layers = jax.random.split(key, 4)
+    h_sample = jnp.zeros((1, seq, d), jnp.float32)
+
+    def init_one(k):
+        from flax import linen as nn
+
+        # unbox the tp-partitioning metadata: stage params shard over the
+        # LOGICAL-STAGE axis here (pp), not over a tp mesh axis
+        return nn.meta.unbox(layer.init(k, h_sample, None, False)["params"])
+
+    stage_params = jax.vmap(init_one)(jax.random.split(k_layers, n_layers))
+    pre_params = {
+        "emb": jax.random.normal(k_emb, (vocab, d)) * (1.0 / np.sqrt(d)),
+        "pos": jax.random.normal(k_pos, (seq, d)) * 0.02,
+    }
+    post_params = {"ro": jax.random.normal(k_ro, (d, vocab)) / np.sqrt(d)}
+
+    def pre_fn(p, toks):  # (mb, S) int32 -> (mb, S, d)
+        return p["emb"][toks] + p["pos"][None, : toks.shape[1]]
+
+    def stage_fn(p, h):
+        # train pinned False (dropout 0 here); mask None = full attention
+        return layer.apply({"params": p}, h, None, False)
+
+    def post_fn(p, h):  # (mb, S, d) -> (mb, S, vocab)
+        return h.astype(jnp.float32) @ p["ro"]
+
+    return (stage_fn, pre_fn, post_fn), (stage_params, pre_params, post_params)
+
+
+def reference_forward(fns, params, toks) -> jnp.ndarray:
+    """The same stack applied sequentially — the numerics oracle."""
+    stage_fn, pre_fn, post_fn = fns
+    stage_params, pre_params, post_params = params
+    h = pre_fn(pre_params, toks)
+    n = jax.tree.leaves(stage_params)[0].shape[0]
+    for i in range(n):
+        h = stage_fn(jax.tree.map(lambda a: a[i], stage_params), h)
+    return post_fn(post_params, h)
+
+
+def make_pp_train_step(fns, mesh, *, n_microbatches, virtual_stages):
+    """Jittable (loss, grads) over the pipeline: next-token cross-entropy."""
+    stage_fn, pre_fn, post_fn = fns
+
+    def train_step(params, toks):
+        stage_params, pre_params, post_params = params
+
+        def loss_fn(stage_params, pre_params, post_params):
+            logits = pipeline_apply(
+                stage_fn, stage_params, toks, mesh=mesh,
+                n_microbatches=n_microbatches,
+                virtual_stages=virtual_stages,
+                pre_fn=pre_fn, pre_params=pre_params,
+                post_fn=post_fn, post_params=post_params,
+            )
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], toks[:, 1:]
+                )
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            stage_params, pre_params, post_params
+        )
+        return loss, grads
+
+    return train_step

@@ -30,19 +30,30 @@ from metaopt_tpu.models.data import synthetic_seq2seq
 from metaopt_tpu.parallel.sharding import shard_batch
 
 
+def _pinit(partitioned: bool, axes):
+    """Megatron partitioning metadata, or a plain init.
+
+    ``partitioned=False`` exists for trunks that run INSIDE another
+    shard_map (the pipeline stages): flax's ``Partitioned.unbox`` applies
+    a sharding constraint whenever any mesh is active, and a "tp" spec
+    inside a pp x dp manual mesh is an error, not a no-op.
+    """
+    init = nn.initializers.lecun_normal()
+    return nn.with_partitioning(init, axes) if partitioned else init
+
+
 class MHA(nn.Module):
     d_model: int
     n_heads: int
     dropout: float = 0.0  # attention-weight dropout (Transformer-base: 0.1)
+    partitioned: bool = True
 
     @nn.compact
     def __call__(self, q_in, kv_in, mask=None, *, train: bool = False):
         d_head = self.d_model // self.n_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (self.n_heads, d_head), axis=-1, dtype=jnp.bfloat16, name=name,
-            kernel_init=nn.with_partitioning(
-                nn.initializers.lecun_normal(), (None, "tp", None)
-            ),
+            kernel_init=_pinit(self.partitioned, (None, "tp", None)),
         )
         q = dense("q")(q_in) / np.sqrt(d_head)
         k = dense("k")(kv_in)
@@ -66,9 +77,7 @@ class MHA(nn.Module):
         key = self.make_rng("dropout") if rate > 0.0 else None
         out_proj = nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=jnp.bfloat16, name="out",
-            kernel_init=nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("tp", None, None)
-            ),
+            kernel_init=_pinit(self.partitioned, ("tp", None, None)),
         )
 
         mesh = active_mesh()
@@ -117,33 +126,31 @@ class FeedForward(nn.Module):
     d_model: int
     d_ff: int
     dropout: float
+    partitioned: bool = True
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         wi = nn.Dense(
             self.d_ff, dtype=jnp.bfloat16, name="wi",
-            kernel_init=nn.with_partitioning(
-                nn.initializers.lecun_normal(), (None, "tp")
-            ),
+            kernel_init=_pinit(self.partitioned, (None, "tp")),
         )
         wo = nn.Dense(
             self.d_model, dtype=jnp.bfloat16, name="wo",
-            kernel_init=nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("tp", None)
-            ),
+            kernel_init=_pinit(self.partitioned, ("tp", None)),
         )
         h = nn.relu(wi(x))
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return wo(h)
 
 
-def _make_mlp(d_model, d_ff, dropout, n_experts, capacity_factor=1.25):
+def _make_mlp(d_model, d_ff, dropout, n_experts, capacity_factor=1.25,
+              partitioned=True):
     if n_experts > 0:
         from metaopt_tpu.models.moe import MoEFeedForward
 
         return MoEFeedForward(d_model, d_ff, n_experts, dropout,
                               capacity_factor, name="mlp")
-    return FeedForward(d_model, d_ff, dropout, name="mlp")
+    return FeedForward(d_model, d_ff, dropout, partitioned, name="mlp")
 
 
 class EncoderLayer(nn.Module):
@@ -153,16 +160,19 @@ class EncoderLayer(nn.Module):
     dropout: float
     n_experts: int = 0
     capacity_factor: float = 1.25
+    partitioned: bool = True
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
         ln = lambda n: nn.LayerNorm(dtype=jnp.float32, name=n)  # noqa: E731
         y = ln("ln1")(x)
         x = x + MHA(self.d_model, self.n_heads, self.dropout,
+                    self.partitioned,
                     name="self_attn")(y, y, pad_mask, train=train)
         y = ln("ln2")(x)
         x = x + _make_mlp(self.d_model, self.d_ff, self.dropout,
-                          self.n_experts, self.capacity_factor)(y, train=train)
+                          self.n_experts, self.capacity_factor,
+                          self.partitioned)(y, train=train)
         return x
 
 

@@ -207,3 +207,50 @@ class TestPipelineEnds:
         for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(gr)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5, rtol=1e-4)
+
+
+class TestPipelineLM:
+    def test_transformer_blocks_under_pp_match_oracle(self):
+        # REAL EncoderLayer stages (self-attn + FFN, bf16 internals) under
+        # the interleaved pp schedule vs sequential application
+        from metaopt_tpu.models.pipeline_lm import (
+            make_pipeline_lm, reference_forward,
+        )
+
+        mesh = make_mesh([("pp", 4), ("dp", 2)])
+        fns, params = make_pipeline_lm(
+            {"d_model": 32, "n_heads": 2, "d_ff": 64, "vocab": 61},
+            n_stages=4, virtual_stages=2, seq=8,
+        )
+        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 8), 1, 61)
+        from metaopt_tpu.parallel.pipeline import pipeline_apply
+
+        y = pipeline_apply(
+            fns[0], params[0], toks, mesh=mesh, n_microbatches=4,
+            virtual_stages=2, pre_fn=fns[1], pre_params=params[1],
+            post_fn=fns[2], post_params=params[2],
+        )
+        ref = reference_forward(fns, params, toks)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   atol=5e-3, rtol=5e-3)  # bf16 trunk
+
+    def test_pp_train_step_produces_finite_grads(self):
+        from metaopt_tpu.models.pipeline_lm import (
+            make_pipeline_lm, make_pp_train_step,
+        )
+
+        mesh = make_mesh([("pp", 4), ("dp", 2)])
+        fns, params = make_pipeline_lm(
+            {"d_model": 32, "n_heads": 2, "d_ff": 64, "vocab": 61},
+            n_stages=4, virtual_stages=2, seq=8,
+        )
+        step = jax.jit(make_pp_train_step(
+            fns, mesh, n_microbatches=4, virtual_stages=2
+        ))
+        toks = jax.random.randint(jax.random.PRNGKey(2), (8, 8), 1, 61)
+        loss, grads = step(params, toks)
+        assert np.isfinite(float(loss)) and float(loss) > 0
+        leaves = jax.tree.leaves(grads)
+        assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
+        # embedding and readout (the pipe's ends) actually receive grads
+        assert any(float(jnp.abs(g).sum()) > 0 for g in leaves)
