@@ -363,19 +363,21 @@ def probe_mosaic(timeout_s: float = 90.0) -> str:
 
 
 def main() -> None:
-    # 3 probes over ~3.5 min: the relay wedge is sometimes transient, and a
-    # TPU number in the driver's record is worth the wait — but a CPU
-    # fallback run must then stay slim (TPE-only, under a minute)
-    preflight_backend(timeout_s=60.0, retries=3)
     # persistent XLA cache, shared with the dryrun and inherited by the
     # model-stage children: repeat bench runs skip the remote compiles
-    # (r2 measured executable serialization working through the relay)
+    # (r2 measured executable serialization working through the relay).
+    # Set BEFORE the preflight: its CPU-fallback path imports jax, and jax
+    # binds these env vars at import time
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".cache", "xla")
     os.makedirs(cache, exist_ok=True)
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES", "none")
+    # 3 probes over ~3.5 min: the relay wedge is sometimes transient, and a
+    # TPU number in the driver's record is worth the wait — but a CPU
+    # fallback run must then stay slim (TPE-only, under a minute)
+    preflight_backend(timeout_s=60.0, retries=3)
     import jax
 
     on_tpu = jax.default_backend() == "tpu"

@@ -97,7 +97,15 @@ class TPE(BaseAlgorithm):
         # suggestion streams (they would dup-collide on register forever)
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
         self._base_key = None                     # PRNGKey, created lazily
-        self._suggest_count = 0                   # PRNG stream position
+        # PRNG stream position as (observation count, pool index within
+        # that fit) — NOT a global launch counter: a speculative refill
+        # that lands just before more observations arrive consumes a
+        # launch that a differently-scheduled run never makes, and a
+        # global counter would shift every later pool. Keying by
+        # (n_obs, pool_idx) makes the served stream a pure function of
+        # the observe/suggest call sequence, whatever the threads did.
+        self._pool_n = -1                         # fit the index counts for
+        self._pool_idx = 0                        # pools launched at that fit
         #: prefetched suggestions from the last kernel launch, valid while
         #: the fit is unchanged (same observation count). A worker asking
         #: for ONE point then pays one launch per ``pool_prefetch`` points
@@ -373,15 +381,22 @@ class TPE(BaseAlgorithm):
         self._sync_device()
         if self._base_key is None:
             self._base_key = jax.random.PRNGKey(self._kernel_seed)
-        count = self._suggest_count
-        self._suggest_count += 1
+        n = len(self._y)
+        if self._pool_n != n:
+            self._pool_n, self._pool_idx = n, 0
+        count = self._pool_idx
+        self._pool_idx += 1
+        # key = fold_in(fold_in(base, n_obs), pool_idx): the stream at one
+        # fit never depends on how many (possibly discarded) launches other
+        # fits made — see _pool_n in __init__
+        fit_key = jax.random.fold_in(self._base_key, n)
         # pad the pool axis to a power of two: the producer's pool size
         # shrinks near max_trials, and n_out is a static (compile-time) shape
         n_out = pad_pow2(num, minimum=1)
         best = np.asarray(
             tpe_suggest_fused(
                 self._Xdev, self._ydev,
-                len(self._y), count, self._base_key,
+                n, count, fit_key,
                 self._n_choices_dev, self._cont_mask_dev,
                 self.gamma, self.prior_weight, self.full_weight_num,
                 n_cand=self.n_ei_candidates,
@@ -427,7 +442,8 @@ class TPE(BaseAlgorithm):
         with getattr(self, "_kernel_lock", threading.RLock()):
             self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
             self._base_key = None
-            self._suggest_count = 0
+            self._pool_n = -1
+            self._pool_idx = 0
             self._prefetch = []
             self._prefetch_n_obs = -1
 
@@ -437,7 +453,8 @@ class TPE(BaseAlgorithm):
             s = super().state_dict()
             s["X"] = [x.tolist() for x in self._X]
             s["y"] = list(self._y)
-            s["suggest_count"] = self._suggest_count
+            s["pool_n"] = self._pool_n
+            s["pool_idx"] = self._pool_idx
             # unserved prefetched points travel with the state: a restored
             # instance must continue the exact suggestion stream, not skip
             # the tail of the batch the live instance had already launched
@@ -450,7 +467,12 @@ class TPE(BaseAlgorithm):
             super().load_state_dict(state)
             self._X = [np.asarray(x, np.float32) for x in state.get("X", [])]
             self._y = list(state.get("y", []))
-            self._suggest_count = int(state.get("suggest_count", 0))
+            self._pool_n = int(state.get("pool_n", -1))
+            # legacy states carried a global launch counter; treat it as
+            # the pool index of the current fit (same continuation intent)
+            self._pool_idx = int(
+                state.get("pool_idx", state.get("suggest_count", 0))
+            )
             self._cap = 0          # invalidate device mirror
             self._n_dev = -1
             self._prefetch = [dict(p) for p in state.get("prefetch", [])]

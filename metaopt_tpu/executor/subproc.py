@@ -73,11 +73,16 @@ class SubprocessExecutor(Executor):
             )
             # the PRODUCER process compiles too (the TPE suggest kernel):
             # share the same cache so a worker restart — or the N-th
-            # parallel worker — skips the first-suggest compile stall
-            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
-            os.environ.setdefault(
-                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1"
-            )
+            # parallel worker — skips the first-suggest compile stall.
+            # jax is already imported here (env vars would be ignored), so
+            # go through the live config; import alone never dials a relay
+            import jax
+
+            if not jax.config.jax_compilation_cache_dir:
+                jax.config.update("jax_compilation_cache_dir", cache)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1
+                )
 
     # -- env/argv assembly -------------------------------------------------
     def _prepare(self, trial: Trial, tmpdir: str) -> tuple[List[str], Dict[str, str], str]:

@@ -33,7 +33,7 @@ from metaopt_tpu.parallel.pipeline import pipeline_apply
 def make_pipeline_lm(
     hparams: Dict[str, Any], n_stages: int, virtual_stages: int = 2,
     seq: int = 16, seed: int = 0,
-) -> Tuple[Any, Any, Any]:
+) -> Tuple[Any, Any]:
     """(stage_fn, pre/post fns, params) for a P·V-layer pipeline LM.
 
     Returns ``(fns, params)`` where ``fns = (stage_fn, pre_fn, post_fn)``

@@ -106,7 +106,7 @@ def pipeline_apply(
     if v_stages > 1 and m % n_stages:
         raise ValueError(
             f"interleaved schedule feeds microbatches in groups of "
-            f"{n_stages}: n_microbatches {m} must be a multiple"
+            f"{n_stages}: n_microbatches {m} must be a multiple of {n_stages}"
         )
     b_local = x.shape[0] // (mesh.shape[ab] if ab else 1)
     if b_local % m:

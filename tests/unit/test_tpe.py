@@ -213,7 +213,7 @@ class TestAsyncLatencyMachinery:
         tpe._warmup_thread.join(timeout=120)
         after = tpe.state_dict()
         # warmup must not advance the PRNG stream or touch observations
-        assert after["suggest_count"] == before["suggest_count"] == 0
+        assert after["pool_idx"] == before["pool_idx"] == 0
         assert after["X"] == before["X"]
 
     def test_uniform_launch_width_beyond_pool(self):
@@ -225,3 +225,28 @@ class TestAsyncLatencyMachinery:
         assert len(pts) == 10
         assert len(tpe._prefetch) == 2
         assert len({space.hash_point(p) for p in pts}) > 1
+
+    def test_stream_invariant_to_refill_timing_across_observes(self):
+        # two observe batches in quick succession: run A lets the first
+        # batch's speculative refill complete (its pool is then discarded
+        # as stale), run B never refills — the served stream must be
+        # IDENTICAL, i.e. independent of how many discarded launches other
+        # fits made (PRNG keyed by (n_obs, pool_idx), not a global counter)
+        space, a = make_tpe(seed=21)
+        _, b = make_tpe(seed=21)
+        b._maybe_refill_async = lambda: None
+        batch1 = [completed(space, {"x": float(i), "c": "a"}, float(i))
+                  for i in range(6)]
+        batch2 = [completed(space, {"x": -3.0, "c": "b"}, -2.0)]
+        for algo in (a, b):
+            algo.suggest(1)          # EI-active
+            algo.observe(batch1)
+        t = a._refill_thread
+        if t is not None:
+            t.join(timeout=60)       # run A's stale pool fully lands
+        a.observe(batch2)
+        b.observe(batch2)
+        t = a._refill_thread
+        if t is not None:
+            t.join(timeout=60)
+        assert a.suggest(3) == b.suggest(3)
