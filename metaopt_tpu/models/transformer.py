@@ -92,8 +92,18 @@ class MHA(nn.Module):
                     f"multiples of the sp mesh axis ({sp}); pad the batch "
                     f"or drop sp from the trial mesh"
                 )
-            # sequence-parallel mesh: K/V ride the ICI ring, the quadratic
-            # logits never exist anywhere (long-context path)
+            # sequence-parallel mesh: the long-context path. Default =
+            # ring attention (K/V ride the ICI ring, lowest per-chip
+            # memory); METAOPT_TPU_SP_IMPL=ulysses selects the all-to-all
+            # head/sequence exchange instead (fewer collectives, needs
+            # per-device heads % sp == 0)
+            from metaopt_tpu.ops.ulysses import sp_impl, ulysses_attention
+
+            if sp_impl() == "ulysses":
+                return out_proj(ulysses_attention(
+                    q, k, v, m3, mesh=mesh,
+                    dropout_rate=rate, dropout_key=key,
+                ))
             from metaopt_tpu.ops.ring_attention import ring_attention
 
             return out_proj(ring_attention(

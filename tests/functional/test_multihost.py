@@ -224,3 +224,54 @@ def test_interleaved_pipeline_across_two_processes(tmp_path):
     outs = _run_pair(PIPELINE_CHILD)
     for i, out in enumerate(outs):
         assert f"proc {i} OK" in out, out
+
+
+ULYSSES_CHILD = r"""
+import os, sys
+proc, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=proc)
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metaopt_tpu.ops.ulysses import ulysses_attention
+
+devs = jax.devices()
+assert len(devs) == 8
+# 1-axis sp mesh spanning both processes: the head/sequence all-to-all
+# exchanges shards ACROSS the process boundary
+mesh = Mesh(np.array(devs), ("sp",))
+
+B, S, H, D = 2, 64, 8, 8
+kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+q = jax.random.normal(kq, (B, S, H, D), jnp.float32) / np.sqrt(D)
+k = jax.random.normal(kk, (B, S, H, D), jnp.float32)
+v = jax.random.normal(kv, (B, S, H, D), jnp.float32)
+
+sharding = NamedSharding(mesh, P(None, "sp", None, None))
+qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+out = jax.jit(lambda a, b, c: ulysses_attention(
+    a, b, c, mesh=mesh, seq_axis="sp", batch_axis=None, head_axis=None
+))(qs, ks, vs)
+
+logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, axis=-1), v)
+for shard in out.addressable_shards:
+    sl = shard.index[1]
+    np.testing.assert_allclose(
+        np.asarray(shard.data), np.asarray(ref[:, sl]), rtol=2e-4, atol=2e-4
+    )
+print(f"proc {proc} OK: ulysses all-to-all matched reference on "
+      f"{len(out.addressable_shards)} local shards", flush=True)
+"""
+
+
+def test_ulysses_across_two_processes(tmp_path):
+    outs = _run_pair(ULYSSES_CHILD)
+    for i, out in enumerate(outs):
+        assert f"proc {i} OK" in out, out
