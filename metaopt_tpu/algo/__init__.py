@@ -11,7 +11,9 @@ GradientDescent (exercises the gradient-result protocol), TPE (KDE
 surrogate + EI as jit/vmap JAX — the north-star hot path), Hyperband,
 ASHA, BOHB (TPE-guided Hyperband), EvolutionES, PBT (asynchronous
 population based training with exploit/explore and checkpoint lineage),
-DEHB (differential evolution over the Hyperband ladder), plus the
+DEHB (differential evolution over the Hyperband ladder), GPBO (GP-EI
+Bayesian optimization — the skopt/robo plugin-lineage family — with the
+exact-MLL fit and acquisition as one jitted program), plus the
 test-support DumbAlgo.
 """
 
@@ -26,6 +28,7 @@ from metaopt_tpu.algo.bohb import BOHB
 from metaopt_tpu.algo.evolution_es import EvolutionES
 from metaopt_tpu.algo.pbt import PBT
 from metaopt_tpu.algo.dehb import DEHB
+from metaopt_tpu.algo.gp_bo import GPBO
 
 __all__ = [
     "BaseAlgorithm",
@@ -41,4 +44,5 @@ __all__ = [
     "EvolutionES",
     "PBT",
     "DEHB",
+    "GPBO",
 ]
