@@ -7,6 +7,9 @@ over the ledger, so dashboards can poll a hunt without touching the
 coordinator's write path:
 
 - ``GET /``                               → route list
+- ``GET /dashboard``                      → self-contained HTML dashboard
+  (experiments table + live regret chart; polls the JSON routes, no
+  external assets, dark-mode aware)
 - ``GET /experiments``                    → summaries (mtpu list)
 - ``GET /experiments/{name}``             → full document + stats (mtpu info)
 - ``GET /experiments/{name}/trials``      → trial docs (``?status=`` filter)
@@ -123,6 +126,109 @@ def lcurve_series(ledger: LedgerBackend, name: str):
     return fid.name, curves
 
 
+#: Self-contained HTML dashboard (no external assets — works air-gapped).
+#: One accessible hue for the single regret series (the title names it, so
+#: no legend); text in ink colors, recessive grid; the trials table is the
+#: table view; per-point tooltips via SVG <title>.
+_DASHBOARD = """<!doctype html>
+<html><head><meta charset="utf-8"><title>metaopt-tpu</title><style>
+:root { --ink:#1f2430; --muted:#667085; --grid:#e4e7ec; --accent:#2458c5;
+        --bg:#ffffff; --row:#f6f7f9; }
+@media (prefers-color-scheme: dark) {
+  :root { --ink:#e6e9ef; --muted:#98a2b3; --grid:#363c47; --accent:#7da2e8;
+          --bg:#15181e; --row:#1d2129; } }
+body { font: 14px/1.5 system-ui, sans-serif; color: var(--ink);
+       background: var(--bg); margin: 2rem; }
+h1 { font-size: 1.2rem; } h2 { font-size: 1rem; }
+table { border-collapse: collapse; min-width: 40rem; }
+th, td { text-align: left; padding: .35rem .8rem; }
+th { color: var(--muted); font-weight: 500;
+     border-bottom: 1px solid var(--grid); }
+tbody tr:nth-child(even) { background: var(--row); }
+tbody tr { cursor: pointer; }
+.done { color: var(--accent); font-weight: 600; }
+svg text { fill: var(--muted); font-size: 11px; }
+.end-label { fill: var(--ink); font-weight: 600; }
+#status { color: var(--muted); margin-left: .6rem; font-size: .85rem; }
+</style></head><body>
+<h1>metaopt-tpu experiments<span id="status"></span></h1>
+<table id="exps"><thead><tr><th>name</th><th>algo</th><th>trials</th>
+<th>completed</th><th>max</th><th>state</th></tr></thead>
+<tbody></tbody></table>
+<h2 id="chart-title" hidden></h2>
+<div id="chart"></div>
+<script>
+const W=640, H=220, PAD=42;
+async function j(u){ const r=await fetch(u); return r.json(); }
+function fmt(v){ return Math.abs(v)>=100?v.toFixed(0)
+                 : Math.abs(v)>=1?v.toFixed(2):v.toPrecision(3); }
+function drawRegret(name, series){
+  // best-objective-so-far vs trial index: a 2px single-hue line on a
+  // recessive grid; the heading names the series (no legend needed)
+  document.getElementById('chart-title').hidden=false;
+  document.getElementById('chart-title').textContent=
+    name+' — best objective so far';
+  if(!series.length){
+    document.getElementById('chart').textContent='no completed trials yet';
+    return;}
+  const ys=series.map(p=>p[1]), xs=series.map(p=>p[0]);
+  const ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const yr=(ymax-ymin)||1, xr=(xs[xs.length-1]-xs[0])||1;
+  const X=i=>PAD+(i-xs[0])/xr*(W-2*PAD), Y=v=>H-PAD-(v-ymin)/yr*(H-2*PAD);
+  const pts=series.map(p=>X(p[0])+','+Y(p[1])).join(' ');
+  let g='';
+  for(const t of [ymin, ymin+yr/2, ymax]){
+    g+=`<line x1="${PAD}" y1="${Y(t)}" x2="${W-PAD}" y2="${Y(t)}"
+         stroke="var(--grid)" stroke-width="1"/>
+        <text x="4" y="${Y(t)+4}">${fmt(t)}</text>`;}
+  const dots=series.map(p=>
+    `<circle cx="${X(p[0])}" cy="${Y(p[1])}" r="8" fill="transparent">
+       <title>trial ${p[0]}: ${fmt(p[1])}</title></circle>`).join('');
+  const last=series[series.length-1];
+  document.getElementById('chart').innerHTML=
+   `<svg width="${W}" height="${H}" role="img"
+         aria-label="regret curve for ${name}">
+      ${g}
+      <polyline points="${pts}" fill="none" stroke="var(--accent)"
+                stroke-width="2" stroke-linejoin="round"/>
+      <circle cx="${X(last[0])}" cy="${Y(last[1])}" r="3"
+              fill="var(--accent)"/>
+      <text class="end-label" x="${Math.min(X(last[0])+6, W-38)}"
+            y="${Y(last[1])-6}">${fmt(last[1])}</text>
+      <text x="${PAD}" y="${H-6}">trial ${xs[0]}</text>
+      <text x="${W-PAD-40}" y="${H-6}">trial ${last[0]}</text>
+      ${dots}
+    </svg>`;
+}
+let selected=null;
+async function refresh(){
+  try{
+    const exps=await j('/experiments');
+    const tb=document.querySelector('#exps tbody'); tb.innerHTML='';
+    for(const e of exps){
+      const tr=document.createElement('tr');
+      tr.innerHTML=`<td>${e.name}</td><td>${e.algorithm??'?'}</td>
+        <td>${e.trials}</td><td>${e.completed}</td>
+        <td>${e.max_trials??'∞'}</td>
+        <td class="${e.done?'done':''}">${e.done?'done':'running'}</td>`;
+      tr.onclick=()=>{selected=e.name; show(e.name);};
+      tb.appendChild(tr);
+    }
+    if(selected===null && exps.length){selected=exps[0].name; show(selected);}
+    document.getElementById('status').textContent=
+      'updated '+new Date().toLocaleTimeString();
+  }catch(err){
+    document.getElementById('status').textContent='unreachable: '+err;
+  }
+}
+async function show(name){
+  const r=await j('/experiments/'+encodeURIComponent(name)+'/regret');
+  drawRegret(name, (r.regret||[]).map(d=>[d.trial, d.best]));
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+
 class _Handler(BaseHTTPRequestHandler):
     ledger: LedgerBackend  # set by make_server on the class
 
@@ -137,10 +243,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_html(self, body: str) -> None:
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         try:
             url = urlparse(self.path)
             parts = [p for p in url.path.split("/") if p]
+            if parts == ["dashboard"]:
+                self._send_html(_DASHBOARD)
+                return
             query = parse_qs(url.query)
             code, payload = self._route(parts, query)
         except Exception as err:  # a bad request must not kill the thread
@@ -152,6 +269,7 @@ class _Handler(BaseHTTPRequestHandler):
         ledger = self.ledger
         if not parts:
             return 200, {"routes": [
+                "/dashboard",
                 "/experiments", "/experiments/{name}",
                 "/experiments/{name}/trials", "/experiments/{name}/regret",
                 "/experiments/{name}/lcurves",

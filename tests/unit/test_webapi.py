@@ -119,3 +119,14 @@ def test_unknown_routes_404(served):
         with pytest.raises(urllib.error.HTTPError) as err:
             get(f"{served}{path}")
         assert err.value.code == 404
+
+
+def test_dashboard_serves_html(served):
+    with urllib.request.urlopen(f"{served}/dashboard", timeout=10) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/html")
+        body = r.read().decode()
+    # the page is self-contained: polls the JSON routes, draws the regret
+    # SVG, and never references an external asset
+    assert "/experiments" in body and "svg" in body.lower()
+    assert "http://" not in body.split("<body>")[1]  # no external fetches
