@@ -291,6 +291,11 @@ def bench_resnet(on_tpu: bool) -> dict:
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
         return loss, new_state["batch_stats"]
 
+    # NOTE: unlike bench_transformer, this stays a python step loop — the
+    # same body wrapped in lax.scan wedges the XLA:CPU compile (>400 s vs
+    # 11 s for the single step; conv-heavy scan bodies are a known CPU
+    # pathology), and the CPU fallback must never hang the driver. At
+    # ResNet-50's ~36 ms/step the per-step dispatch RTT is a minor term.
     @jax.jit
     def step(p, bs, o):
         (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, bs)
