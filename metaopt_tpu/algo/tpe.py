@@ -19,9 +19,11 @@ variants total and suggest() latency stays flat past 10k observations.
 
 from __future__ import annotations
 
+import atexit
 import logging
 import math
 import threading
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -38,6 +40,18 @@ from metaopt_tpu.ops.tpe_math import (
     tpe_suggest_fused,
 )
 from metaopt_tpu.space import Space, UnitCube
+
+#: live instances whose background threads must finish before interpreter
+#: teardown — a daemon thread mid-XLA at shutdown aborts the process
+_live_instances: "weakref.WeakSet[TPE]" = weakref.WeakSet()
+
+
+@atexit.register
+def _drain_background_threads() -> None:
+    for inst in list(_live_instances):
+        for t in (inst._warmup_thread, inst._refill_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=30.0)
 
 
 @algo_registry.register("tpe")
@@ -128,6 +142,7 @@ class TPE(BaseAlgorithm):
         self._warmup_thread: Optional[threading.Thread] = None
         self._refill_thread: Optional[threading.Thread] = None
         self._ei_active = False
+        _live_instances.add(self)
 
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:

@@ -166,6 +166,22 @@ def build_parser() -> argparse.ArgumentParser:
     web.add_argument("--port", type=int, default=0,
                      help="0 binds an ephemeral port (printed at startup)")
 
+    bm = sub.add_parser(
+        "benchmark",
+        help="compare algorithms on standard tasks (benchmark studies)",
+    )
+    bm.add_argument("--algos", nargs="+", default=["random", "tpe"],
+                    help="algorithm names, e.g. --algos random tpe gp")
+    bm.add_argument("--task", default="rosenbrock",
+                    help="benchmark task (rosenbrock/branin/sphere/rastrigin)")
+    bm.add_argument("--max-trials", type=int, default=25,
+                    help="trial budget per repetition")
+    bm.add_argument("--repetitions", type=int, default=3)
+    bm.add_argument("--assessment", choices=("result", "rank"),
+                    default="result",
+                    help="result = mean best-so-far; rank = mean final rank")
+    bm.add_argument("--json", dest="as_json", action="store_true")
+
     srv = sub.add_parser(
         "serve", help="run the pod coordinator (single-writer ledger service)"
     )
@@ -857,8 +873,49 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
     return 0
 
 
+def _cmd_benchmark(args, cfg) -> int:
+    """Run one study (task × assessment) across the requested algorithms."""
+    from metaopt_tpu.benchmark import (
+        AverageRank, AverageResult, Benchmark, task_registry,
+    )
+
+    try:
+        task_cls = task_registry.get(args.task)
+    except KeyError:
+        print(f"unknown task {args.task!r}; have: "
+              f"{', '.join(sorted(task_registry))}", file=sys.stderr)
+        return 2
+    assess = (AverageRank if args.assessment == "rank"
+              else AverageResult)(args.repetitions)
+    bench = Benchmark(
+        "cli",
+        algorithms=list(args.algos),
+        targets=[{"assess": [assess], "task": [task_cls(args.max_trials)]}],
+    )
+    bench.process()
+    (report,) = bench.analysis()
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+        return 0
+    print(f"task: {report['task']}  assessment: {report['assessment']}  "
+          f"repetitions: {report['repetitions']}")
+    if "final_best" in report:
+        width = max(len(a) for a in args.algos)
+        for algo in sorted(report["final_best"],
+                           key=lambda a: report["final_best"][a]):
+            print(f"  {algo:<{width}}  final best = "
+                  f"{report['final_best'][algo]:.6g}")
+    if "ranks" in report:
+        width = max(len(a) for a in args.algos)
+        for algo in sorted(report["ranks"], key=lambda a: report["ranks"][a]):
+            print(f"  {algo:<{width}}  mean rank = {report['ranks'][algo]:.2f}")
+    print(f"winner: {report['winner']}")
+    return 0
+
+
 _COMMANDS = {
     "hunt": _cmd_hunt,
+    "benchmark": _cmd_benchmark,
     "init-only": _cmd_init_only,
     "insert": _cmd_insert,
     "db": _cmd_db,

@@ -183,3 +183,19 @@ def test_plot_lcurve_ascii_and_no_fidelity_error(tmp_path, capsys):
     led2 = seeded_experiment(tmp_path)
     with pytest.raises(SystemExit, match="fidelity"):
         cli_main(["plot", "lcurve", "-n", "seeded", "--ledger", led2])
+
+
+def test_benchmark_command(capsys):
+    rc = cli_main(["benchmark", "--algos", "random", "--task", "sphere",
+                   "--max-trials", "6", "--repetitions", "1", "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["task"] == "sphere"
+    assert report["winner"] == "random"
+    assert len(report["curves"]["random"]) > 0
+
+
+def test_benchmark_unknown_task(capsys):
+    rc = cli_main(["benchmark", "--task", "nope"])
+    assert rc == 2
+    assert "unknown task" in capsys.readouterr().err
