@@ -37,6 +37,10 @@ def build_parser() -> argparse.ArgumentParser:
     def common(sp):
         sp.add_argument("-n", "--name", help="experiment name")
         sp.add_argument("--config", help="framework config YAML")
+        sp.add_argument("--algo", default=None,
+                        help="algorithm name with default settings — the "
+                             "no-YAML shortcut for `algorithm: {NAME: {}}` "
+                             "(e.g. --algo tpe | gp | asha)")
         sp.add_argument("--max-trials", type=int, dest="max_trials")
         sp.add_argument("--pool-size", type=int, dest="pool_size")
         sp.add_argument(
@@ -288,11 +292,22 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
             "adapter": adapter.describe(),
         }
         version = parent_doc.get("version", 1) + 1
+    from metaopt_tpu.io.resolve_config import DEFAULTS
+
+    algorithm = cfg.get("algorithm")
+    if getattr(args, "algo", None):
+        explicit = algorithm not in (None, DEFAULTS["algorithm"])
+        if explicit and list(algorithm) != [args.algo]:
+            raise SystemExit(
+                f"--algo {args.algo} conflicts with config algorithm "
+                f"{list(algorithm)[0]!r}; pick one"
+            )
+        algorithm = algorithm if explicit else {args.algo: {}}
     exp = Experiment(
         name,
         ledger,
         space=space,
-        algorithm=cfg.get("algorithm"),
+        algorithm=algorithm,
         max_trials=cfg.get("max_trials", 100),
         pool_size=cfg.get("pool_size", 1),
         metadata=metadata,

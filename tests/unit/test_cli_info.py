@@ -199,3 +199,25 @@ def test_benchmark_unknown_task(capsys):
     rc = cli_main(["benchmark", "--task", "nope"])
     assert rc == 2
     assert "unknown task" in capsys.readouterr().err
+
+
+def test_hunt_algo_shortcut(tmp_path):
+    # --algo NAME creates the experiment with that algorithm, no YAML
+    led = str(tmp_path / "led")
+    rc = cli_main(["init-only", "-n", "shortcut", "--algo", "gp",
+                   "--ledger", led, "--max-trials", "5",
+                   "--", "script.py", "-x~uniform(0, 1)"])
+    assert rc == 0
+    ledger = _make_ledger_from_spec(led, {})
+    doc = ledger.load_experiment("shortcut")
+    assert list(doc["algorithm"]) == ["gp"]
+
+
+def test_hunt_algo_conflicts_with_explicit_config(tmp_path):
+    cfgfile = tmp_path / "cfg.yaml"
+    cfgfile.write_text("algorithm:\n  tpe: {}\n")
+    with pytest.raises(SystemExit, match="conflicts"):
+        cli_main(["init-only", "-n", "clash", "--algo", "gp",
+                  "--config", str(cfgfile),
+                  "--ledger", str(tmp_path / "led2"),
+                  "--", "script.py", "-x~uniform(0, 1)"])
