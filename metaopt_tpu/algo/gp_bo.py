@@ -150,6 +150,53 @@ def gp_suggest_fused(
     return cand[top]
 
 
+@functools.partial(jax.jit, static_argnames=("fit_iters",))
+def _fit_ard(X, y, mask, fit_lr, *, fit_iters: int):
+    """Fitted (log_ls, log_amp, log_noise) for importance analysis."""
+    d = X.shape[1]
+    params = {
+        "log_ls": jnp.zeros(d) + jnp.log(0.3),
+        "log_amp": jnp.asarray(0.0),
+        "log_noise": jnp.asarray(jnp.log(1e-2)),
+    }
+    tx = optax.adam(fit_lr)
+    opt_state = tx.init(params)
+
+    def step(carry, _):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(_neg_mll)(params, X, y, mask)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    (params, _), _ = jax.lax.scan(step, (params, opt_state), None,
+                                  length=fit_iters)
+    return params
+
+
+def ard_importance(
+    X: np.ndarray, y: np.ndarray, *, fit_iters: int = 80, fit_lr: float = 0.05
+) -> np.ndarray:
+    """Per-dimension importance from a fitted ARD GP, normalized to sum 1.
+
+    The ARD RBF's sensitivity along dimension d scales as 1/lengthscale²:
+    a short lengthscale means the objective bends quickly along that axis
+    (the lineage's LPI role, computed from the surrogate this framework
+    already runs on-device). X in the unit cube (n, d); y raw objectives.
+    """
+    n, d = X.shape
+    npad = pad_pow2(max(n, 2))
+    Xp = np.zeros((npad, d), np.float32)
+    Xp[:n] = X
+    yp = np.zeros(npad, np.float32)
+    yp[:n] = (y - y.mean()) / (y.std() + 1e-8)
+    mask = np.zeros(npad, np.float32)
+    mask[:n] = 1.0
+    params = _fit_ard(jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask),
+                      fit_lr, fit_iters=fit_iters)
+    inv_sq = np.asarray(jnp.exp(-2.0 * params["log_ls"]), np.float64)
+    return inv_sq / max(inv_sq.sum(), 1e-12)
+
+
 @algo_registry.register("gp")
 class GPBO(BaseAlgorithm):
     def __init__(

@@ -127,12 +127,15 @@ def build_parser() -> argparse.ArgumentParser:
     info.add_argument("--json", action="store_true", dest="as_json")
 
     plot = sub.add_parser("plot", help="optimization diagnostics")
-    plot.add_argument("kind", choices=["regret", "lcurve", "parallel"],
+    plot.add_argument("kind",
+                      choices=["regret", "lcurve", "parallel", "importance"],
                       help="regret: best-objective-so-far per completed "
                            "trial; lcurve: objective vs fidelity budget per "
                            "lineage (multi-fidelity experiments); parallel: "
                            "parallel-coordinates data (params + objective "
-                           "per completed trial, JSON)")
+                           "per completed trial, JSON); importance: "
+                           "per-parameter importance from a fitted ARD GP "
+                           "surrogate (the lineage's LPI role)")
     common(plot)
     plot.add_argument("--json", action="store_true", dest="as_json")
 
@@ -622,6 +625,8 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
         return _plot_lcurve(args, ledger)
     if args.kind == "parallel":
         return _plot_parallel(args, ledger)
+    if args.kind == "importance":
+        return _plot_importance(args, ledger)
     points = regret_series(ledger, args.name)
     if args.as_json:
         print(json.dumps({"experiment": args.name, "regret": points},
@@ -645,6 +650,43 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
         print(f"{label:>12.4g} |{''.join(row)}")
     print(f"{'':>12} +{'-' * len(bests)}")
     print(f"final best: {bests[-1]:.6g}")
+    return 0
+
+
+def _plot_importance(args, ledger) -> int:
+    """Per-parameter importance from the ARD GP surrogate's lengthscales.
+
+    ref: the lineage's LPI (local parameter importance) plot — here the
+    sensitivities come from the same jitted GP the `gp` algorithm runs.
+    """
+    import numpy as np
+
+    from metaopt_tpu.algo.gp_bo import ard_importance
+    from metaopt_tpu.space import UnitCube, build_space
+
+    doc = ledger.load_experiment(args.name)
+    space = build_space(doc["space"])
+    cube = UnitCube(space)
+    done = [t for t in ledger.fetch(args.name, "completed")
+            if t.objective is not None]
+    if len(done) < 4:
+        print(f"need at least 4 completed trials, have {len(done)}")
+        return 1
+    X = np.stack([cube.transform(t.params) for t in done])
+    y = np.asarray([t.objective for t in done], np.float32)
+    imp = ard_importance(X, y)
+    names = list(space.keys())
+    pairs = sorted(zip(names, imp.tolist()), key=lambda p: -p[1])
+    if args.as_json:
+        print(json.dumps({"experiment": args.name, "trials": len(done),
+                          "importance": dict(pairs)}, indent=2))
+        return 0
+    print(f"parameter importance ({args.name}, ARD GP over "
+          f"{len(done)} completed trials):")
+    width = max(len(n) for n in names)
+    for name, v in pairs:
+        bar = "#" * max(1, int(v * 40))
+        print(f"  {name:<{width}}  {v:6.1%}  {bar}")
     return 0
 
 

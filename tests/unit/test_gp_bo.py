@@ -101,3 +101,43 @@ class TestGPBO:
 
         algo = make_algorithm(make_space(), {"gp": {"seed": 1}})
         assert isinstance(algo, GPBO)
+
+
+class TestImportance:
+    def test_dominant_dimension_wins(self):
+        from metaopt_tpu.algo.gp_bo import ard_importance
+
+        rng = np.random.default_rng(0)
+        X = rng.random((40, 3)).astype(np.float32)
+        # objective depends almost entirely on dim 1
+        y = (10.0 * (X[:, 1] - 0.4) ** 2 + 0.01 * X[:, 0]).astype(np.float32)
+        imp = ard_importance(X, y)
+        assert imp.shape == (3,)
+        assert abs(imp.sum() - 1.0) < 1e-6
+        assert imp[1] > 0.6 and imp[1] == imp.max()
+
+    def test_plot_importance_cli(self, tmp_path, capsys):
+        from metaopt_tpu.cli.main import _make_ledger_from_spec, main as cli_main
+        from metaopt_tpu.ledger import Experiment
+        from metaopt_tpu.space import build_space
+
+        led = str(tmp_path / "led")
+        ledger = _make_ledger_from_spec(led, {})
+        space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+        exp = Experiment("imp", ledger, space=space, max_trials=20).configure()
+        rng = np.random.default_rng(1)
+        for _ in range(10):
+            pt = {"a": float(rng.random()), "b": float(rng.random())}
+            t = exp.make_trial(pt)
+            exp.register_trials([t])
+            got = exp.reserve_trial("w")
+            exp.push_results(got, [{"name": "o", "type": "objective",
+                                    "value": 5 * (pt["a"] - 0.5) ** 2}])
+        rc = cli_main(["plot", "importance", "-n", "imp", "--ledger", led,
+                       "--json"])
+        assert rc == 0
+        import json as _json
+
+        report = _json.loads(capsys.readouterr().out)
+        assert set(report["importance"]) == {"a", "b"}
+        assert report["importance"]["a"] > report["importance"]["b"]
