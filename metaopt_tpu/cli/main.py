@@ -656,34 +656,23 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
 def _plot_importance(args, ledger) -> int:
     """Per-parameter importance from the ARD GP surrogate's lengthscales.
 
-    ref: the lineage's LPI (local parameter importance) plot — here the
-    sensitivities come from the same jitted GP the `gp` algorithm runs.
+    ref: the lineage's LPI (local parameter importance) plot — the
+    computation is shared with GET /experiments/{name}/importance so the
+    two surfaces can never disagree.
     """
-    import numpy as np
+    from metaopt_tpu.io.webapi import importance_series
 
-    from metaopt_tpu.algo.gp_bo import ard_importance
-    from metaopt_tpu.space import UnitCube, build_space
-
-    doc = ledger.load_experiment(args.name)
-    space = build_space(doc["space"])
-    cube = UnitCube(space)
-    done = [t for t in ledger.fetch(args.name, "completed")
-            if t.objective is not None]
-    if len(done) < 4:
-        print(f"need at least 4 completed trials, have {len(done)}")
+    code, payload = importance_series(ledger, args.name)
+    if code != 200:
+        print(payload.get("error", "importance unavailable"))
         return 1
-    X = np.stack([cube.transform(t.params) for t in done])
-    y = np.asarray([t.objective for t in done], np.float32)
-    imp = ard_importance(X, y)
-    names = list(space.keys())
-    pairs = sorted(zip(names, imp.tolist()), key=lambda p: -p[1])
     if args.as_json:
-        print(json.dumps({"experiment": args.name, "trials": len(done),
-                          "importance": dict(pairs)}, indent=2))
+        print(json.dumps(payload, indent=2))
         return 0
+    pairs = sorted(payload["importance"].items(), key=lambda p: -p[1])
     print(f"parameter importance ({args.name}, ARD GP over "
-          f"{len(done)} completed trials):")
-    width = max(len(n) for n in names)
+          f"{payload['trials']} completed trials):")
+    width = max(len(n) for n, _ in pairs)
     for name, v in pairs:
         bar = "#" * max(1, int(v * 40))
         print(f"  {name:<{width}}  {v:6.1%}  {bar}")

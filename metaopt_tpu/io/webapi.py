@@ -16,6 +16,8 @@ coordinator's write path:
 - ``GET /experiments/{name}/regret``      → best-so-far series (mtpu plot)
 - ``GET /experiments/{name}/lcurves``     → objective per fidelity budget
   per lineage (mtpu plot lcurve)
+- ``GET /experiments/{name}/importance``  → per-parameter importance from
+  the ARD GP surrogate (mtpu plot importance)
 - ``GET /healthz``                        → liveness
 
 Deliberately read-only: every write still flows through the single-writer
@@ -97,6 +99,35 @@ def parallel_series(ledger: LedgerBackend, name: str):
         if t.objective is not None
     ]
     return dims, rows
+
+
+def importance_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
+    """(status, payload) for GET /experiments/{name}/importance.
+
+    Parameter importance from the jitted ARD GP surrogate (see
+    metaopt_tpu.algo.gp_bo.ard_importance); shares the exact computation
+    with `mtpu plot importance`.
+    """
+    import numpy as np
+
+    from metaopt_tpu.algo.gp_bo import ard_importance
+    from metaopt_tpu.space import UnitCube, build_space
+
+    doc = ledger.load_experiment(name) or {}
+    if not doc.get("space"):
+        return 400, {"error": f"{name!r} has no stored space"}
+    space = build_space(doc["space"])
+    done = [t for t in ledger.fetch(name, "completed")
+            if t.objective is not None]
+    if len(done) < 4:
+        return 400, {"error": f"need at least 4 completed trials, "
+                              f"have {len(done)}"}
+    cube = UnitCube(space)
+    X = np.stack([cube.transform(t.params) for t in done])
+    y = np.asarray([t.objective for t in done], np.float32)
+    imp = ard_importance(X, y)
+    return 200, {"experiment": name, "trials": len(done),
+                 "importance": dict(zip(space.keys(), imp.tolist()))}
 
 
 def lcurve_series(ledger: LedgerBackend, name: str):
@@ -273,7 +304,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "/experiments", "/experiments/{name}",
                 "/experiments/{name}/trials", "/experiments/{name}/regret",
                 "/experiments/{name}/lcurves",
-                "/experiments/{name}/parallel", "/healthz",
+                "/experiments/{name}/parallel",
+                "/experiments/{name}/importance", "/healthz",
             ]}
         if parts == ["healthz"]:
             return 200, {"ok": True}
@@ -307,6 +339,8 @@ class _Handler(BaseHTTPRequestHandler):
             dims, rows = parallel_series(ledger, name)
             return 200, {"experiment": name, "dimensions": dims,
                          "trials": rows}
+        if parts[2] == "importance":
+            return importance_series(ledger, name)
         return 404, {"error": f"unknown route /{'/'.join(parts)}"}
 
 

@@ -130,3 +130,39 @@ def test_dashboard_serves_html(served):
     # SVG, and never references an external asset
     assert "/experiments" in body and "svg" in body.lower()
     assert "http://" not in body.split("<body>")[1]  # no external fetches
+
+
+def test_importance_endpoint_needs_trials(served):
+    # the shared fixture has only 3 completed trials -> clear 400
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        get(f"{served}/experiments/api/importance")
+    assert err.value.code == 400
+
+
+def test_importance_endpoint():
+    ledger = MemoryLedger()
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    exp = Experiment("imp", ledger, space=space, max_trials=20).configure()
+    import numpy as np
+
+    rng = np.random.default_rng(2)
+    for _ in range(8):
+        pt = {"a": float(rng.random()), "b": float(rng.random())}
+        t = exp.make_trial(pt)
+        exp.register_trials([t])
+        got = exp.reserve_trial("w")
+        exp.push_results(got, [{"name": "o", "type": "objective",
+                                "value": 7 * (pt["a"] - 0.5) ** 2}])
+    server = make_server(ledger)
+    t = start_in_thread(server)
+    host, port = server.server_address[:2]
+    try:
+        status, doc = get(f"http://{host}:{port}/experiments/imp/importance")
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert status == 200
+    assert abs(sum(doc["importance"].values()) - 1.0) < 1e-6
+    assert doc["importance"]["a"] > doc["importance"]["b"]
