@@ -147,11 +147,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "(replays completed trials into the algorithm)")
 
     db = sub.add_parser("db", help="ledger backend utilities")
-    db.add_argument("action", choices=["test", "rm"],
+    db.add_argument("action", choices=["test", "rm", "compact"],
                     help="test: drive the full backend contract (create, "
                          "dup-detect, reserve CAS, heartbeat, stale "
                          "release) against the configured ledger; "
-                         "rm: delete an experiment and its trials")
+                         "rm: delete an experiment and its trials; "
+                         "compact: rewrite a native ledger's append-only "
+                         "log to its live state (reclaims heartbeat spam)")
     db.add_argument("-n", "--name", help="experiment to delete (rm)")
     db.add_argument("--force", action="store_true",
                     help="rm: required to actually delete")
@@ -763,6 +765,21 @@ def _cmd_db(args, cfg: Dict[str, Any]) -> int:
     )
 
     ledger = _make_ledger_from_spec(args.ledger, cfg)
+    if args.action == "compact":
+        if not hasattr(ledger, "compact"):
+            raise SystemExit(
+                f"backend {type(ledger).__name__} has no compaction (only "
+                "the native ledgerstore appends an ever-growing log)"
+            )
+        names = ([args.name] if args.name
+                 else sorted(ledger.list_experiments()))
+        total = 0
+        for name in names:
+            freed = ledger.compact(name)
+            total += freed
+            print(f"{name}: reclaimed {freed} bytes")
+        print(f"total reclaimed: {total} bytes")
+        return 0
     if args.action == "rm":
         # ref: `orion db rm` in the lineage — destructive, so --force gates
         if not args.name:

@@ -208,6 +208,22 @@ class NativeFileLedger(FileLedger):
         with lk:
             return int(self._lib.ls_count(h, self._status_csv(status)))
 
+    def compact(self, experiment: str) -> int:
+        """Rewrite the experiment's log to its live state; bytes reclaimed.
+
+        Heartbeat records (~40 bytes each, one per reservation refresh)
+        and superseded document versions otherwise accumulate forever.
+        Safe with live workers: the rewrite happens under the same flock
+        every op takes, and other processes detect the replaced inode and
+        rebuild from the fresh file.
+        """
+        h, lk = self._handle(experiment)
+        with lk:
+            freed = int(self._lib.ls_compact(h))
+        if freed < 0:
+            raise OSError(f"ledgerstore compaction failed for {experiment}")
+        return freed
+
     def release_stale(self, experiment: str, timeout_s: float) -> List[Trial]:
         h, lk = self._handle(experiment)
         with lk:

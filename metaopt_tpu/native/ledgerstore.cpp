@@ -98,6 +98,7 @@ class Store {
    public:
     explicit Guard(Store* s) : s_(s) {
       ::flock(s_->lock_fd_, LOCK_EX);
+      s_->maybe_reopen();
       s_->replay_tail();
     }
     ~Guard() { ::flock(s_->lock_fd_, LOCK_UN); }
@@ -228,6 +229,50 @@ class Store {
     return n;
   }
 
+  // Rewrite the log as two records per live key (a put carrying the FIFO
+  // sort key, then a mark restoring worker/heartbeat) — heartbeat spam and
+  // superseded document versions vanish. Other live processes detect the
+  // inode change under the lock (maybe_reopen) and rebuild their index
+  // from the fresh file. Returns bytes reclaimed, or -1 on IO failure.
+  long compact() {
+    Guard g(this);
+    struct stat st_old;
+    if (fstat(log_fd_, &st_old) != 0) return -1;
+    const std::string tmp_path = dir_ + "/trials.log.tmp";
+    int tmp_fd = ::open(tmp_path.c_str(),
+                        O_CREAT | O_TRUNC | O_WRONLY, 0666);
+    if (tmp_fd < 0) return -1;
+    std::string out(kMagic, sizeof(kMagic));
+    for (const auto& key : order_) {
+      auto it = index_.find(key);
+      if (it == index_.end()) continue;
+      const Entry& e = it->second;
+      append_record(out, Record{1, key, e.status, "", e.payload, e.order});
+      append_record(out, Record{3, key, "", e.worker, "", e.heartbeat});
+    }
+    bool ok = ::write(tmp_fd, out.data(), out.size()) ==
+              static_cast<ssize_t>(out.size());
+    ok = ok && ::fsync(tmp_fd) == 0;
+    ::close(tmp_fd);
+    if (!ok) {
+      ::unlink(tmp_path.c_str());
+      return -1;
+    }
+    if (::rename(tmp_path.c_str(), (dir_ + "/trials.log").c_str()) != 0) {
+      ::unlink(tmp_path.c_str());
+      return -1;
+    }
+    // our own fd still points at the replaced inode — reopen and mark the
+    // whole fresh file as applied (index_ already reflects it)
+    ::close(log_fd_);
+    log_fd_ = ::open((dir_ + "/trials.log").c_str(),
+                     O_CREAT | O_RDWR | O_APPEND, 0666);
+    if (log_fd_ < 0) return -1;
+    applied_ = out.size();
+    return static_cast<long>(st_old.st_size) -
+           static_cast<long>(out.size());
+  }
+
  private:
   static std::vector<std::string> split_csv(const char* csv) {
     std::vector<std::string> out;
@@ -275,7 +320,7 @@ class Store {
     b += s;
   }
 
-  bool append(const Record& r) {
+  static void append_record(std::string& out, const Record& r) {
     std::string body;
     body.push_back(static_cast<char>(r.op));
     put_str16(body, r.key);
@@ -284,14 +329,34 @@ class Store {
     body.append(reinterpret_cast<const char*>(&r.heartbeat), 8);
     put_u32(body, static_cast<uint32_t>(r.payload.size()));
     body += r.payload;
+    put_u32(out, static_cast<uint32_t>(body.size()));
+    out += body;
+  }
 
+  bool append(const Record& r) {
     std::string rec;
-    put_u32(rec, static_cast<uint32_t>(body.size()));
-    rec += body;
+    append_record(rec, r);
     ssize_t n = ::write(log_fd_, rec.data(), rec.size());
     if (n != static_cast<ssize_t>(rec.size())) return false;
     applied_ += rec.size();
     return true;
+  }
+
+  // A compaction by another process replaced the log inode: reopen from
+  // the path and rebuild from scratch (caller holds the lock; replay_tail
+  // right after this repopulates the index from the fresh file).
+  void maybe_reopen() {
+    struct stat st_fd, st_path;
+    if (fstat(log_fd_, &st_fd) != 0) return;
+    if (::stat((dir_ + "/trials.log").c_str(), &st_path) != 0) return;
+    if (st_fd.st_ino == st_path.st_ino && st_fd.st_dev == st_path.st_dev)
+      return;
+    ::close(log_fd_);
+    log_fd_ = ::open((dir_ + "/trials.log").c_str(),
+                     O_CREAT | O_RDWR | O_APPEND, 0666);
+    index_.clear();
+    order_.clear();
+    applied_ = sizeof(kMagic);
   }
 
   void apply(const Record& r) {
@@ -446,6 +511,8 @@ char* ls_fetch(void* h, const char* status_csv) {
 long ls_count(void* h, const char* status_csv) {
   return static_cast<Store*>(h)->count(status_csv);
 }
+
+long ls_compact(void* h) { return static_cast<Store*>(h)->compact(); }
 
 void ls_free(char* p) { free(p); }
 
