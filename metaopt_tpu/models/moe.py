@@ -1,19 +1,21 @@
 """Mixture-of-Experts feed-forward with expert parallelism ("ep").
 
-Switch-style top-1 routing (Fedus et al.; see PAPERS.md) with
-**capacity-bounded dispatch**: each expert processes at most
-``capacity = ceil(capacity_factor · tokens / n_experts)`` tokens per step.
-Kept tokens are scattered into per-expert slabs of that static shape, the
-expert FFNs run as batched einsums over ``(E, capacity, d)``, and results
-gather back to token order — so FLOPs scale with the *token* count
-(``E · capacity ≈ capacity_factor · T``), not with ``E × T`` like a dense
-all-experts dispatch. Tokens that overflow an expert's queue are dropped
-for the layer (their FFN output is zero; the transformer's residual
-connection carries them through unchanged — standard Switch behavior) and
-counted in the ``"moe_stats"`` collection.
+Top-k routing with **capacity-bounded dispatch**: ``router_top_k=1`` is
+Switch (Fedus et al. — gate = the chosen expert's raw probability);
+``router_top_k=2`` is GShard-style top-2 (gates renormalized over the
+chosen pair). Each expert processes at most ``capacity = ceil(
+capacity_factor · k · T / E)`` dispatch items per step: the (token,
+choice) pairs are scattered into per-expert slabs of that static shape,
+the expert FFNs run as batched einsums over ``(E, capacity, d)``, and
+results gather back and sum per token — FLOPs scale with
+``capacity_factor · k · T``, not with ``E × T`` like a dense all-experts
+dispatch. Items that overflow an expert's queue are dropped for the layer
+(that choice contributes zero; the transformer's residual connection
+carries the token through — standard Switch/GShard behavior) and counted
+in the ``"moe_stats"`` collection.
 
 Everything is static-shaped for XLA: capacity comes from the (static)
-token count, queue positions are a cumsum over token order, and
+token count, queue positions are a cumsum over dispatch order, and
 drop-vs-keep is a branchless scatter to an overflow slot that is sliced
 away. Expert weights shard E/ep per chip via ``nn.with_partitioning``;
 GSPMD inserts the token-shuffle collectives around the scatter/gather, the
@@ -22,13 +24,14 @@ each expert the hidden dim still splits over "tp", so ep composes with the
 Megatron split.
 
 The router adds the standard switch load-balancing auxiliary loss
-(``n_experts · Σ_e fraction_e · mean_prob_e``), surfaced through the
-module's ``"aux_loss"`` collection so the train step can weigh it in; the
-dropped-token fraction rides the ``"moe_stats"`` collection the same way.
+(``n_experts · Σ_e fraction_e · mean_prob_e``, assignment fractions
+averaged over the k choices), surfaced through the module's
+``"aux_loss"`` collection so the train step can weigh it in; the
+dropped-item fraction rides the ``"moe_stats"`` collection the same way.
 
-``capacity_factor <= 0`` selects the dense all-experts dispatch — O(E·T)
-compute, no dropping — kept as the numerics oracle the capacity path is
-tested against.
+``capacity_factor <= 0`` selects the dense dispatch — O(k·E·T) compute,
+no dropping — kept as the numerics oracle the capacity path is tested
+against.
 
 ref: the reference framework has no model code (SURVEY.md §2.8) — this is
 demo-zoo surface, here so trials can exercise expert-parallel shardings
@@ -49,13 +52,17 @@ class MoEFeedForward(nn.Module):
     d_ff: int
     n_experts: int
     dropout: float = 0.0
-    #: per-expert queue length = capacity_factor · T / E; <= 0 = dense oracle
+    #: per-expert queue = capacity_factor·k·T/E items; <= 0 = dense oracle
     capacity_factor: float = 1.25
+    #: experts per token: 1 = Switch (raw top prob gate), 2 = GShard-style
+    #: top-2 (gates renormalized over the chosen pair)
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         b, s, d = x.shape
         e, f = self.n_experts, self.d_ff
+        k = max(1, min(int(self.router_top_k), e))
 
         router = nn.Dense(e, dtype=jnp.float32, name="router")
         wi = self.param(
@@ -73,63 +80,74 @@ class MoEFeedForward(nn.Module):
 
         logits = router(x.astype(jnp.float32))            # (b, s, E)
         probs = nn.softmax(logits, axis=-1)
-        top = jnp.argmax(probs, axis=-1)                  # (b, s)
-        onehot = jax.nn.one_hot(top, e, dtype=jnp.float32)
-        gate = jnp.sum(probs * onehot, axis=-1)           # (b, s)
+        top_p, top_idx = jax.lax.top_k(probs, k)          # (b, s, k)
+        if k == 1:
+            gates = top_p                                 # Switch: raw prob
+        else:  # GShard: renormalize over the chosen experts
+            gates = top_p / jnp.clip(
+                jnp.sum(top_p, axis=-1, keepdims=True), 1e-9, None
+            )
+        onehot_k = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # (b,s,k,E)
+        assigned = jnp.sum(onehot_k, axis=2)              # (b, s, E)
 
-        # switch load-balancing loss: fraction of tokens vs mean prob per
-        # expert — pushes the router toward uniform utilization
-        frac = jnp.mean(onehot, axis=(0, 1))              # (E,)
+        # switch load-balancing loss: fraction of assignments vs mean prob
+        # per expert — pushes the router toward uniform utilization
+        frac = jnp.mean(assigned, axis=(0, 1)) / k        # (E,)
         mean_prob = jnp.mean(probs, axis=(0, 1))          # (E,)
         self.sow("aux_loss", "moe_balance",
                  e * jnp.sum(frac * mean_prob))
 
         dropout = nn.Dropout(self.dropout, deterministic=not train)
 
-        if self.capacity_factor <= 0:
-            # dense all-experts oracle: (E, b, s, d) masked token copies —
-            # E× the useful FLOPs, but exact (nothing dropped)
-            xe = jnp.einsum("bse,bsd->ebsd", onehot, x.astype(jnp.float32))
+        def expert_ffn(xe):
+            """Batched-over-experts two-matmul FFN on bf16."""
             h = nn.relu(jnp.einsum(
-                "ebsd,edf->ebsf",
+                "e...d,edf->e...f",
                 xe.astype(jnp.bfloat16), wi.astype(jnp.bfloat16)
             ))
-            h = dropout(h)
-            ye = jnp.einsum("ebsf,efd->ebsd", h, wo.astype(jnp.bfloat16))
-            y = jnp.einsum("ebsd,bse->bsd", ye.astype(jnp.float32), onehot)
-            return (y * gate[..., None]).astype(x.dtype)
+            return jnp.einsum(
+                "e...f,efd->e...d", dropout(h), wo.astype(jnp.bfloat16)
+            )
 
-        # ---- capacity-bounded scatter/gather dispatch ----
+        if self.capacity_factor <= 0:
+            # dense oracle: every expert sees every (token, choice) copy —
+            # k·E× the useful FLOPs, but exact (nothing dropped)
+            y = jnp.zeros((b, s, d), jnp.float32)
+            for j in range(k):
+                oh = onehot_k[:, :, j]                    # (b, s, E)
+                xe = jnp.einsum("bse,bsd->ebsd", oh, x.astype(jnp.float32))
+                ye = expert_ffn(xe)
+                yj = jnp.einsum("ebsd,bse->bsd", ye.astype(jnp.float32), oh)
+                y = y + yj * gates[:, :, j][..., None]
+            return y.astype(x.dtype)
+
+        # ---- capacity-bounded scatter/gather dispatch over t·k items ----
         t = b * s
-        cap = max(1, int(math.ceil(self.capacity_factor * t / e)))
-        xf = x.reshape(t, d)
-        topf = top.reshape(t)
-        # queue position of each token within its expert, in token order
-        ohf = onehot.reshape(t, e)
-        pos_all = jnp.cumsum(ohf, axis=0) - 1.0           # (t, E)
+        cap = max(1, int(math.ceil(self.capacity_factor * k * t / e)))
+        items = jnp.repeat(x.reshape(t, d), k, axis=0)    # (t·k, d)
+        expf = top_idx.reshape(t * k)                     # item -> expert
+        gatef = gates.reshape(t * k)
+        # queue position of each item within its expert, in dispatch order
+        ohf = onehot_k.reshape(t * k, e)
+        pos_all = jnp.cumsum(ohf, axis=0) - 1.0           # (t·k, E)
         pos = jnp.take_along_axis(
-            pos_all, topf[:, None], axis=1
-        )[:, 0].astype(jnp.int32)                         # (t,)
+            pos_all, expf[:, None], axis=1
+        )[:, 0].astype(jnp.int32)                         # (t·k,)
         kept = pos < cap
         self.sow("moe_stats", "dropped_fraction",
                  1.0 - jnp.mean(kept.astype(jnp.float32)))
 
-        # branchless scatter: overflowing tokens land in slot `cap`, which
+        # branchless scatter: overflowing items land in slot `cap`, which
         # is sliced away; kept (expert, slot) pairs are unique by cumsum
-        dst = jnp.where(kept, pos, cap)                   # (t,)
+        dst = jnp.where(kept, pos, cap)                   # (t·k,)
         buf = jnp.zeros((e, cap + 1, d), x.dtype)
-        expert_in = buf.at[topf, dst].set(xf)[:, :cap]    # (E, cap, d)
+        expert_in = buf.at[expf, dst].set(items)[:, :cap]  # (E, cap, d)
 
-        h = nn.relu(jnp.einsum(
-            "ecd,edf->ecf",
-            expert_in.astype(jnp.bfloat16), wi.astype(jnp.bfloat16)
-        ))
-        h = dropout(h)
-        out = jnp.einsum("ecf,efd->ecd", h, wo.astype(jnp.bfloat16))
+        out = expert_ffn(expert_in)
 
-        # gather back to token order; dropped tokens contribute zero (the
-        # caller's residual connection carries them through)
-        y = out[topf, jnp.minimum(dst, cap - 1)].astype(jnp.float32)
-        y = jnp.where(kept[:, None], y, 0.0)
-        y = (y * gate.reshape(t)[:, None]).reshape(b, s, d)
+        # gather back per item; dropped items contribute zero (the caller's
+        # residual connection carries their token through)
+        y = out[expf, jnp.minimum(dst, cap - 1)].astype(jnp.float32)
+        y = jnp.where(kept[:, None], y, 0.0) * gatef[:, None]
+        y = jnp.sum(y.reshape(t, k, d), axis=1).reshape(b, s, d)
         return y.astype(x.dtype)

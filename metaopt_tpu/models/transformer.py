@@ -154,12 +154,12 @@ class FeedForward(nn.Module):
 
 
 def _make_mlp(d_model, d_ff, dropout, n_experts, capacity_factor=1.25,
-              partitioned=True):
+              partitioned=True, router_top_k=1):
     if n_experts > 0:
         from metaopt_tpu.models.moe import MoEFeedForward
 
         return MoEFeedForward(d_model, d_ff, n_experts, dropout,
-                              capacity_factor, name="mlp")
+                              capacity_factor, router_top_k, name="mlp")
     return FeedForward(d_model, d_ff, dropout, partitioned, name="mlp")
 
 
@@ -171,6 +171,7 @@ class EncoderLayer(nn.Module):
     n_experts: int = 0
     capacity_factor: float = 1.25
     partitioned: bool = True
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
@@ -182,7 +183,7 @@ class EncoderLayer(nn.Module):
         y = ln("ln2")(x)
         x = x + _make_mlp(self.d_model, self.d_ff, self.dropout,
                           self.n_experts, self.capacity_factor,
-                          self.partitioned)(y, train=train)
+                          self.partitioned, self.router_top_k)(y, train=train)
         return x
 
 
@@ -193,19 +194,24 @@ class DecoderLayer(nn.Module):
     dropout: float
     n_experts: int = 0
     capacity_factor: float = 1.25
+    partitioned: bool = True
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(self, x, enc, causal_mask, cross_mask, train: bool = False):
         ln = lambda n: nn.LayerNorm(dtype=jnp.float32, name=n)  # noqa: E731
         y = ln("ln1")(x)
         x = x + MHA(self.d_model, self.n_heads, self.dropout,
+                    self.partitioned,
                     name="self_attn")(y, y, causal_mask, train=train)
         y = ln("ln2")(x)
         x = x + MHA(self.d_model, self.n_heads, self.dropout,
+                    self.partitioned,
                     name="cross_attn")(y, enc, cross_mask, train=train)
         y = ln("ln3")(x)
         x = x + _make_mlp(self.d_model, self.d_ff, self.dropout,
-                          self.n_experts, self.capacity_factor)(y, train=train)
+                          self.n_experts, self.capacity_factor,
+                          self.partitioned, self.router_top_k)(y, train=train)
         return x
 
 
@@ -224,6 +230,8 @@ class Transformer(nn.Module):
     n_experts: int = 0
     #: per-expert queue = capacity_factor*T/E tokens; <=0 = dense dispatch
     capacity_factor: float = 1.25
+    #: experts per token: 1 = Switch, 2 = GShard-style top-2
+    router_top_k: int = 1
     #: rematerialize each layer in the backward pass: activation memory
     #: drops from O(layers) to O(1) layers, buying batch size (and with it
     #: MFU) at ~1/3 extra FLOPs — the standard TPU HBM trade
@@ -259,7 +267,7 @@ class Transformer(nn.Module):
         for i in range(self.n_layers):
             x = enc_cls(self.d_model, self.n_heads, self.d_ff,
                         self.dropout, self.n_experts,
-                        self.capacity_factor,
+                        self.capacity_factor, True, self.router_top_k,
                         name=f"enc{i}")(x, src_pad, train)
         enc = nn.LayerNorm(dtype=jnp.float32, name="enc_ln")(x).astype(jnp.bfloat16)
 
@@ -267,7 +275,8 @@ class Transformer(nn.Module):
         for i in range(self.n_layers):
             y = dec_cls(self.d_model, self.n_heads, self.d_ff,
                         self.dropout, self.n_experts,
-                        self.capacity_factor, name=f"dec{i}")(
+                        self.capacity_factor, True, self.router_top_k,
+                        name=f"dec{i}")(
                 y, enc, causal_mask, cross_mask, train
             )
         y = nn.LayerNorm(dtype=jnp.float32, name="dec_ln")(y)
@@ -293,6 +302,7 @@ def make_model(hparams: Optional[Dict[str, Any]] = None, **overrides) -> Transfo
         dropout=float(h.get("dropout", 0.1)),
         n_experts=int(h.get("n_experts", 0)),
         capacity_factor=float(h.get("capacity_factor", 1.25)),
+        router_top_k=int(h.get("router_top_k", 1)),
         remat=bool(h.get("remat", False)),
     )
 
