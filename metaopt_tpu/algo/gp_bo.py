@@ -229,6 +229,12 @@ class GPBO(BaseAlgorithm):
         self._X: List[np.ndarray] = []
         self._y: List[float] = []
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
+        # pooled suggestions from the last launch, valid while the fit
+        # (observation count) is unchanged — same doctrine as TPE: the
+        # launch computes a pow2-padded pool anyway, so serve the leftovers
+        # instead of refitting per ask
+        self._prefetch: List[Dict[str, Any]] = []
+        self._prefetch_n_obs = -1
 
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:
@@ -242,6 +248,11 @@ class GPBO(BaseAlgorithm):
         return self._suggest_ei(num)
 
     def _suggest_ei(self, num: int) -> List[Dict[str, Any]]:
+        if (self._prefetch_n_obs == len(self._y)
+                and len(self._prefetch) >= num):
+            out = self._prefetch[:num]
+            self._prefetch = self._prefetch[num:]
+            return out
         n = len(self._y)
         d = self.cube.n_dims
         npad = pad_pow2(n)
@@ -265,28 +276,38 @@ class GPBO(BaseAlgorithm):
             fit_iters=self.fit_iters,
             n_cand=pad_pow2(self.n_candidates),
             n_out=n_out,
-        ))[:num]
+        ))
         fid = self.space.fidelity
-        out = []
+        pts = []
         for row in best:
             pt = self.cube.untransform(np.asarray(row))
             if fid is not None:
                 pt[fid.name] = fid.high
-            out.append(pt)
+            pts.append(pt)
+        out, self._prefetch = pts[:num], pts[num:]
+        self._prefetch_n_obs = n
         return out
 
     def seed_rng(self, seed: Optional[int]) -> None:
         super().seed_rng(seed)
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
+        self._prefetch = []
+        self._prefetch_n_obs = -1
 
     # -- persistence -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
         s = super().state_dict()
         s["X"] = [x.tolist() for x in self._X]
         s["y"] = list(self._y)
+        # unserved pool points travel so a restored instance continues the
+        # same suggestion stream instead of refitting mid-pool
+        s["prefetch"] = [dict(p) for p in self._prefetch]
+        s["prefetch_n_obs"] = self._prefetch_n_obs
         return s
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         super().load_state_dict(state)
         self._X = [np.asarray(x, np.float32) for x in state.get("X", [])]
         self._y = list(state.get("y", []))
+        self._prefetch = [dict(p) for p in state.get("prefetch", [])]
+        self._prefetch_n_obs = int(state.get("prefetch_n_obs", -1))
