@@ -232,9 +232,13 @@ class GPBO(BaseAlgorithm):
         # pooled suggestions from the last launch, valid while the fit
         # (observation count) is unchanged — same doctrine as TPE: the
         # launch computes a pow2-padded pool anyway, so serve the leftovers
-        # instead of refitting per ask
+        # instead of refitting per ask. (_pool_n, _pool_idx) key the PRNG
+        # stream: a re-launch at the same fit MUST draw fresh candidates,
+        # not re-serve the points it already issued
         self._prefetch: List[Dict[str, Any]] = []
         self._prefetch_n_obs = -1
+        self._pool_n = -1
+        self._pool_idx = 0
 
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:
@@ -265,10 +269,13 @@ class GPBO(BaseAlgorithm):
         y[:n] = (y_raw - mu) / sd
         fit_mask = np.zeros(npad, np.float32)
         fit_mask[:n] = 1.0
+        if self._pool_n != n:
+            self._pool_n, self._pool_idx = n, 0
         key = jax.random.fold_in(
             jax.random.fold_in(jax.random.PRNGKey(self._kernel_seed), n),
-            num,
+            self._pool_idx,
         )
+        self._pool_idx += 1
         n_out = pad_pow2(max(num, self.pool_prefetch), minimum=1)
         best = np.asarray(gp_suggest_fused(
             jnp.asarray(X), jnp.asarray(y), jnp.asarray(fit_mask),
@@ -293,6 +300,8 @@ class GPBO(BaseAlgorithm):
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
         self._prefetch = []
         self._prefetch_n_obs = -1
+        self._pool_n = -1
+        self._pool_idx = 0
 
     # -- persistence -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
@@ -303,6 +312,8 @@ class GPBO(BaseAlgorithm):
         # same suggestion stream instead of refitting mid-pool
         s["prefetch"] = [dict(p) for p in self._prefetch]
         s["prefetch_n_obs"] = self._prefetch_n_obs
+        s["pool_n"] = self._pool_n
+        s["pool_idx"] = self._pool_idx
         return s
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
@@ -311,3 +322,5 @@ class GPBO(BaseAlgorithm):
         self._y = list(state.get("y", []))
         self._prefetch = [dict(p) for p in state.get("prefetch", [])]
         self._prefetch_n_obs = int(state.get("prefetch_n_obs", -1))
+        self._pool_n = int(state.get("pool_n", -1))
+        self._pool_idx = int(state.get("pool_idx", 0))

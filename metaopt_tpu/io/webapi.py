@@ -191,6 +191,10 @@ svg text { fill: var(--muted); font-size: 11px; }
 <script>
 const W=640, H=220, PAD=42;
 async function j(u){ const r=await fetch(u); return r.json(); }
+// experiment names are user-controlled strings headed into innerHTML —
+// escape or a hostile `-n` becomes stored XSS for anyone watching
+function esc(s){ return String(s).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c])); }
 function fmt(v){ return Math.abs(v)>=100?v.toFixed(0)
                  : Math.abs(v)>=1?v.toFixed(2):v.toPrecision(3); }
 function drawRegret(name, series){
@@ -218,7 +222,7 @@ function drawRegret(name, series){
   const last=series[series.length-1];
   document.getElementById('chart').innerHTML=
    `<svg width="${W}" height="${H}" role="img"
-         aria-label="regret curve for ${name}">
+         aria-label="regret curve for ${esc(name)}">
       ${g}
       <polyline points="${pts}" fill="none" stroke="var(--accent)"
                 stroke-width="2" stroke-linejoin="round"/>
@@ -238,9 +242,9 @@ async function refresh(){
     const tb=document.querySelector('#exps tbody'); tb.innerHTML='';
     for(const e of exps){
       const tr=document.createElement('tr');
-      tr.innerHTML=`<td>${e.name}</td><td>${e.algorithm??'?'}</td>
-        <td>${e.trials}</td><td>${e.completed}</td>
-        <td>${e.max_trials??'∞'}</td>
+      tr.innerHTML=`<td>${esc(e.name)}</td><td>${esc(e.algorithm??'?')}</td>
+        <td>${esc(e.trials)}</td><td>${esc(e.completed)}</td>
+        <td>${esc(e.max_trials??'∞')}</td>
         <td class="${e.done?'done':''}">${e.done?'done':'running'}</td>`;
       tr.onclick=()=>{selected=e.name; show(e.name);};
       tb.appendChild(tr);

@@ -269,8 +269,13 @@ class Store {
                      O_CREAT | O_RDWR | O_APPEND, 0666);
     if (log_fd_ < 0) return -1;
     applied_ = out.size();
-    return static_cast<long>(st_old.st_size) -
-           static_cast<long>(out.size());
+    // a log of pure put records can legally GROW slightly (two records per
+    // key after compaction): that is still success, not an IO failure —
+    // report zero reclaimed rather than a negative the caller would treat
+    // as an error
+    long saved = static_cast<long>(st_old.st_size) -
+                 static_cast<long>(out.size());
+    return saved > 0 ? saved : 0;
   }
 
  private:

@@ -141,3 +141,20 @@ class TestImportance:
         report = _json.loads(capsys.readouterr().out)
         assert set(report["importance"]) == {"a", "b"}
         assert report["importance"]["a"] > report["importance"]["b"]
+
+
+def test_pool_drain_draws_fresh_candidates():
+    # 5 asks with pool_prefetch=4 at one fit: the re-launch after the pool
+    # drains must fold in a pool counter, not regenerate the same top-EI
+    # points (which the producer's dedup would collapse into zero work)
+    space = make_space()
+    algo = GPBO(space, seed=9, n_initial_points=4, pool_prefetch=4)
+    for i in range(4):
+        pt = algo.suggest(1)[0]
+        algo.observe([completed(space, pt, float(i))])
+    seen = set()
+    for _ in range(6):
+        pt = algo.suggest(1)[0]
+        key = space.hash_point(pt)
+        assert key not in seen, "re-served an already-issued suggestion"
+        seen.add(key)

@@ -242,3 +242,16 @@ class TestNativeCompaction:
         nxt = ledger_b.reserve("c", "wB")
         assert nxt is not None
         assert ledger_a.get("c", nxt.id).worker == "wB"
+
+    def test_compact_puts_only_log_is_success(self, tmp_path):
+        # a log of pure put records grows slightly on compaction (two
+        # records per key) — that must read as success/0 bytes, not OSError
+        ledger = self._native(tmp_path)
+        ledger.create_experiment({"name": "c", "max_trials": 10})
+        for i in range(4):
+            t = Trial(params={"x": float(i)}, experiment="c")
+            t.lineage = f"l{i}"
+            ledger.register(t)
+        freed = ledger.compact("c")
+        assert freed >= 0
+        assert ledger.count("c") == 4
