@@ -76,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
                       action="append", metavar="NAME=VALUE",
                       help="value backfilled into parent trials for a "
                            "dimension the child space added (repeatable)")
+    hunt.add_argument("--branch-rename", dest="branch_rename",
+                      action="append", metavar="OLD=NEW",
+                      help="carry parent dimension OLD into child "
+                           "dimension NEW (repeatable)")
     hunt.add_argument("--producer", default=None, choices=["local", "coord"],
                       help="where suggestion runs: 'local' fits the algorithm "
                            "in this worker; 'coord' delegates to the "
@@ -99,6 +103,8 @@ def build_parser() -> argparse.ArgumentParser:
     init = sub.add_parser("init-only", help="create the experiment and exit")
     common(init)
     init.add_argument("--branch-from", dest="branch_from", default=None)
+    init.add_argument("--branch-rename", dest="branch_rename",
+                      action="append", metavar="OLD=NEW")
     init.add_argument("--branch-default", dest="branch_default",
                       action="append", metavar="NAME=VALUE")
     init.add_argument("cmd", nargs=argparse.REMAINDER)
@@ -284,16 +290,23 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
                 defaults[key] = json.loads(raw)
             except json.JSONDecodeError:
                 defaults[key] = raw
+        renames: Dict[str, str] = {}
+        for kv in getattr(args, "branch_rename", None) or []:
+            old, sep, new = kv.partition("=")
+            if not sep:
+                raise SystemExit(f"--branch-rename wants OLD=NEW, got {kv!r}")
+            renames[old] = new
         if space is None:  # same space, new version (config/code change)
             space = parent_space
             user_argv = list(parent_doc.get("user_args", []))
         try:  # fail at branch time, not at first produce
-            adapter = TrialAdapter(parent_space, space, defaults)
+            adapter = TrialAdapter(parent_space, space, defaults, renames)
         except BranchConflictError as err:
             raise SystemExit(f"cannot branch from {branch!r}: {err}")
         metadata["branch"] = {
             "parent": branch,
             "defaults": defaults,
+            "renames": renames,
             "adapter": adapter.describe(),
         }
         version = parent_doc.get("version", 1) + 1

@@ -15,6 +15,8 @@ module adds the lineage's branching story on top, re-based onto the ledger:
   * prior/range changed        → keep the trial iff the value still fits,
   * dimension added in child   → fill from an explicit default
     (``--branch-default name=value``) — refusing to guess is the point,
+  * dimension renamed in child → carry the parent value under the new
+    name (``--branch-rename old=new``), filtered against the new prior,
   * dimension deleted in child → strip the value.
 
 Adapted trials keep their results and point at the original via
@@ -41,14 +43,32 @@ class TrialAdapter:
         parent_space: Space,
         child_space: Space,
         defaults: Optional[Dict[str, Any]] = None,
+        renames: Optional[Dict[str, str]] = None,
     ) -> None:
         self.parent_space = parent_space
         self.child_space = child_space
         defaults = dict(defaults or {})
-        #: (name, action, dimension, fill_value)
+        renames = dict(renames or {})  # old parent name -> new child name
+        for old, new in renames.items():
+            if old not in parent_space:
+                raise BranchConflictError(
+                    f"--branch-rename {old}={new}: parent has no "
+                    f"dimension {old!r}"
+                )
+            if new not in child_space:
+                raise BranchConflictError(
+                    f"--branch-rename {old}={new}: child space has no "
+                    f"dimension {new!r}"
+                )
+        by_new = {new: old for old, new in renames.items()}
+        #: (name, action, dimension, fill_value_or_source)
         self._plan: List[tuple] = []
         for name, dim in child_space.items():
-            if name in parent_space:
+            if name in by_new:
+                # renamed: carry the parent's value under the new name,
+                # filtered against the (possibly different) new prior
+                self._plan.append((name, "rename", dim, by_new[name]))
+            elif name in parent_space:
                 action = (
                     "pass"
                     if parent_space[name].configuration == dim.configuration
@@ -73,7 +93,12 @@ class TrialAdapter:
                 f"--branch-default for unknown dimension(s): "
                 f"{sorted(defaults)}"
             )
-        self.deleted = [n for n in parent_space.keys() if n not in child_space]
+        renamed_away = set(renames)
+        self.deleted = [
+            n for n in parent_space.keys()
+            if n not in child_space and n not in renamed_away
+        ]
+        self.renames = renames
 
     def adapt_params(self, params: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         """Child-space params for a parent point, or None if it can't fit."""
@@ -82,10 +107,11 @@ class TrialAdapter:
             if action == "fill":
                 out[name] = fill
                 continue
-            if name not in params:
+            source = fill if action == "rename" else name
+            if source not in params:
                 return None
-            value = params[name]
-            if action == "filter" and value not in dim:
+            value = params[source]
+            if action in ("filter", "rename") and value not in dim:
                 return None  # prior shrank / moved; the old point fell out
             out[name] = value
         return out
@@ -112,5 +138,6 @@ class TrialAdapter:
             "passed": [n for n, a, _, _ in self._plan if a == "pass"],
             "filtered": [n for n, a, _, _ in self._plan if a == "filter"],
             "filled": {n: f for n, a, _, f in self._plan if a == "fill"},
+            "renamed": dict(self.renames),
             "deleted": list(self.deleted),
         }

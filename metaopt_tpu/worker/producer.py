@@ -112,6 +112,7 @@ class Producer:
                         build_space(parent_doc["space"]),
                         exp.space,
                         branch.get("defaults"),
+                        branch.get("renames"),
                     )
                     return [a for a in map(adapter.adapt, fetched) if a]
                 except BranchConflictError as err:

@@ -207,3 +207,42 @@ class TestCLIBranch:
                 str(tmp_path / "l"), "--branch-from", "ghost",
                 "--", "x.py", "-x~uniform(0, 1)",
             ])
+
+
+class TestRenameAdapter:
+    def test_renamed_dimension_carries_values(self):
+        from metaopt_tpu.ledger.evc import TrialAdapter
+        from metaopt_tpu.space import build_space
+
+        parent = build_space({"lr": "loguniform(1e-5, 1e-1)",
+                              "mom": "uniform(0.5, 0.99)"})
+        child = build_space({"learning_rate": "loguniform(1e-5, 1e-1)",
+                             "mom": "uniform(0.5, 0.99)"})
+        ad = TrialAdapter(parent, child, renames={"lr": "learning_rate"})
+        out = ad.adapt_params({"lr": 1e-3, "mom": 0.9})
+        assert out == {"learning_rate": 1e-3, "mom": 0.9}
+        assert ad.describe()["renamed"] == {"lr": "learning_rate"}
+        assert "lr" not in ad.describe()["deleted"]
+
+    def test_rename_filters_against_new_prior(self):
+        from metaopt_tpu.ledger.evc import TrialAdapter
+        from metaopt_tpu.space import build_space
+
+        parent = build_space({"lr": "loguniform(1e-5, 1e-1)"})
+        child = build_space({"learning_rate": "loguniform(1e-4, 1e-2)"})
+        ad = TrialAdapter(parent, child, renames={"lr": "learning_rate"})
+        assert ad.adapt_params({"lr": 1e-3}) == {"learning_rate": 1e-3}
+        assert ad.adapt_params({"lr": 5e-2}) is None  # outside new prior
+
+    def test_rename_unknown_dimensions_rejected(self):
+        import pytest as _pytest
+
+        from metaopt_tpu.ledger.evc import BranchConflictError, TrialAdapter
+        from metaopt_tpu.space import build_space
+
+        parent = build_space({"lr": "loguniform(1e-5, 1e-1)"})
+        child = build_space({"learning_rate": "loguniform(1e-5, 1e-1)"})
+        with _pytest.raises(BranchConflictError, match="no\\s+dimension"):
+            TrialAdapter(parent, child, renames={"nope": "learning_rate"})
+        with _pytest.raises(BranchConflictError, match="no\\s+dimension"):
+            TrialAdapter(parent, child, renames={"lr": "nope"})
