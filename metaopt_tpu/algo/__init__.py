@@ -11,7 +11,8 @@ GradientDescent (exercises the gradient-result protocol), TPE (KDE
 surrogate + EI as jit/vmap JAX — the north-star hot path), Hyperband,
 ASHA, BOHB (TPE-guided Hyperband), EvolutionES, PBT (asynchronous
 population based training with exploit/explore and checkpoint lineage),
-DEHB (differential evolution over the Hyperband ladder), GPBO (GP-EI
+DEHB (differential evolution over the Hyperband ladder), CMAES (the pycma/nevergrad
+plugin family, async generations), GPBO (GP-EI
 Bayesian optimization — the skopt/robo plugin-lineage family — with the
 exact-MLL fit and acquisition as one jitted program), plus the
 test-support DumbAlgo.
@@ -29,6 +30,7 @@ from metaopt_tpu.algo.evolution_es import EvolutionES
 from metaopt_tpu.algo.pbt import PBT
 from metaopt_tpu.algo.dehb import DEHB
 from metaopt_tpu.algo.gp_bo import GPBO
+from metaopt_tpu.algo.cmaes import CMAES
 
 __all__ = [
     "BaseAlgorithm",
@@ -44,5 +46,6 @@ __all__ = [
     "EvolutionES",
     "PBT",
     "DEHB",
+    "CMAES",
     "GPBO",
 ]

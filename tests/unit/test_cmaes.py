@@ -1,0 +1,86 @@
+"""CMA-ES: cohort barrier, adaptation sanity, convergence, replay identity."""
+
+import numpy as np
+import pytest
+
+from metaopt_tpu.algo import CMAES
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space import build_space
+
+
+def make_space():
+    return build_space({"x": "uniform(-5, 5)", "y": "uniform(-5, 5)"})
+
+
+def completed(space, params, objective):
+    t = Trial(params=params, experiment="e")
+    t.lineage = space.hash_point(params)
+    t.transition("reserved")
+    t.attach_results([{"name": "o", "type": "objective", "value": objective}])
+    t.transition("completed")
+    return t
+
+
+class TestCMAES:
+    def test_generation_barrier(self):
+        space = make_space()
+        algo = CMAES(space, seed=0, population_size=6)
+        pts = algo.suggest(100)
+        assert len(pts) == 6  # one generation, then the barrier
+        assert algo.suggest(1) == []  # waiting on results
+        for i, p in enumerate(pts):
+            algo.observe([completed(space, p, float(i))])
+        nxt = algo.suggest(6)
+        assert len(nxt) == 6  # adaptation fired, next cohort issued
+
+    def test_converges_on_quadratic(self):
+        space = make_space()
+        algo = CMAES(space, seed=3, population_size=8)
+
+        def f(p):
+            return (p["x"] - 1.0) ** 2 + (p["y"] + 2.0) ** 2
+
+        best = np.inf
+        for _ in range(15):  # generations
+            pts = algo.suggest(8)
+            if not pts:
+                break
+            trials = []
+            for p in pts:
+                obj = f(p)
+                best = min(best, obj)
+                trials.append(completed(space, p, obj))
+            algo.observe(trials)
+        assert best < 0.1, f"CMA-ES failed to localize the bowl: best={best}"
+        assert algo._sigma < algo.sigma0  # step size contracted near optimum
+
+    def test_rebuilt_instance_issues_identical_generation(self):
+        # coordinator-restart doctrine: same seed + same generation index
+        # must regenerate the same candidates so ledger dedup absorbs them
+        space = make_space()
+        a = CMAES(space, seed=7, population_size=5)
+        b = CMAES(space, seed=7, population_size=5)
+        assert a.suggest(5) == b.suggest(5)
+
+    def test_state_roundtrip_mid_generation(self):
+        space = make_space()
+        algo = CMAES(space, seed=5, population_size=5)
+        first = algo.suggest(2)
+        clone = CMAES(space, seed=5, population_size=5)
+        clone.load_state_dict(algo.state_dict())
+        assert clone.suggest(3) == algo.suggest(3)  # same cohort tail
+
+    def test_max_generations_is_done(self):
+        space = make_space()
+        algo = CMAES(space, seed=1, population_size=4, max_generations=1)
+        pts = algo.suggest(4)
+        for i, p in enumerate(pts):
+            algo.observe([completed(space, p, float(i))])
+        assert algo.suggest(1) == []
+        assert algo.is_done
+
+    def test_registered(self):
+        from metaopt_tpu.algo.base import make_algorithm
+
+        algo = make_algorithm(make_space(), {"cmaes": {"seed": 2}})
+        assert isinstance(algo, CMAES)
