@@ -146,23 +146,31 @@ class CMAES(BaseAlgorithm):
         self._issued = 0
 
     def _suggest_one(self) -> Optional[Dict[str, Any]]:
-        cohort = {self.space.hash_point(p) for p in self._candidates}
-        if cohort and cohort <= set(self._results):
-            self._advance_generation()
-            cohort = set()
-        if (self.max_generations is not None
-                and self.generation >= self.max_generations):
-            return None
-        if not self._candidates:
-            self._gen_candidates()
-        while self._issued < len(self._candidates):
-            pt = self._candidates[self._issued]
-            self._issued += 1
-            lineage = self.space.hash_point(pt)
-            if lineage not in self._assigned:
-                self._assigned.add(lineage)
-                return dict(pt)
-        return None  # cohort fully issued; waiting on results
+        # catch-up loop: a rebuilt instance replaying N completed
+        # generations must fast-forward through ALL of them in one call,
+        # not burn one idle produce cycle per generation. Bounded: a
+        # σ-collapsed distribution can keep hashing onto already-evaluated
+        # lineages, and that must not spin forever.
+        for _ in range(256):
+            cohort = {self.space.hash_point(p) for p in self._candidates}
+            if cohort and cohort <= set(self._results):
+                self._advance_generation()
+                continue
+            if (self.max_generations is not None
+                    and self.generation >= self.max_generations):
+                return None
+            if not self._candidates:
+                self._gen_candidates()
+                continue  # the fresh cohort may itself be fully observed
+            while self._issued < len(self._candidates):
+                pt = self._candidates[self._issued]
+                self._issued += 1
+                lineage = self.space.hash_point(pt)
+                if lineage not in self._assigned:
+                    self._assigned.add(lineage)
+                    return dict(pt)
+            return None  # cohort fully issued; waiting on results
+        return None  # catch-up cap hit (σ-collapse); let is_done decide
 
     def _advance_generation(self) -> None:
         d = self.cube.n_dims

@@ -49,6 +49,7 @@ class TrialAdapter:
         self.child_space = child_space
         defaults = dict(defaults or {})
         renames = dict(renames or {})  # old parent name -> new child name
+        targets: Dict[str, str] = {}
         for old, new in renames.items():
             if old not in parent_space:
                 raise BranchConflictError(
@@ -60,7 +61,21 @@ class TrialAdapter:
                     f"--branch-rename {old}={new}: child space has no "
                     f"dimension {new!r}"
                 )
-        by_new = {new: old for old, new in renames.items()}
+            if new in targets:
+                raise BranchConflictError(
+                    f"--branch-rename targets collide: both "
+                    f"{targets[new]!r} and {old!r} map to {new!r}"
+                )
+            if new in parent_space:
+                # refusing to guess is the point: `new` exists in BOTH
+                # spaces, so pass-through and rename are ambiguous
+                raise BranchConflictError(
+                    f"--branch-rename {old}={new}: {new!r} already exists "
+                    f"in the parent space — renaming onto it would "
+                    f"silently discard one dimension's values"
+                )
+            targets[new] = old
+        by_new = targets
         #: (name, action, dimension, fill_value_or_source)
         self._plan: List[tuple] = []
         for name, dim in child_space.items():

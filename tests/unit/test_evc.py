@@ -246,3 +246,18 @@ class TestRenameAdapter:
             TrialAdapter(parent, child, renames={"nope": "learning_rate"})
         with _pytest.raises(BranchConflictError, match="no\\s+dimension"):
             TrialAdapter(parent, child, renames={"lr": "nope"})
+
+    def test_duplicate_or_shadowing_rename_targets_rejected(self):
+        import pytest as _pytest
+
+        from metaopt_tpu.ledger.evc import BranchConflictError, TrialAdapter
+        from metaopt_tpu.space import build_space
+
+        parent = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+        child = build_space({"c": "uniform(0, 1)"})
+        with _pytest.raises(BranchConflictError, match="collide"):
+            TrialAdapter(parent, child, renames={"a": "c", "b": "c"})
+        # renaming onto a name that also exists in the parent is ambiguous
+        child2 = build_space({"b": "uniform(0, 1)"})
+        with _pytest.raises(BranchConflictError, match="already exists"):
+            TrialAdapter(parent, child2, renames={"a": "b"})
