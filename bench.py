@@ -256,12 +256,17 @@ def bench_transformer(on_tpu: bool, seq: int = 256, batch: int = 64) -> dict:
     # the step runs data-parallel over the whole mesh: peak scales with it
     peak = peak_flops(jax.devices()[0]) * mesh.size
     mfu = (flops / (dt_ms / 1000)) / peak if peak else 0.0
+    from metaopt_tpu.ops.attention import attention_impl
+
     tag = f"_seq{seq}" if on_tpu else ""
     return {
         f"transformer_step_ms{tag}": round(dt_ms, 3),
         f"transformer_tokens_per_s{tag}": round(batch * seq / (dt_ms / 1000)),
         f"mfu{tag}" if on_tpu else "mfu": round(mfu, 4),
-        f"transformer_config{tag}": {**cfg, "batch": batch, "seq": seq},
+        f"transformer_config{tag}": {
+            **cfg, "batch": batch, "seq": seq,
+            "attention": attention_impl() or "reference",
+        },
     }
 
 
