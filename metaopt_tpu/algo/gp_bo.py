@@ -31,7 +31,6 @@ from the base class contract.
 from __future__ import annotations
 
 import functools
-import math
 from typing import Any, Dict, List, Optional
 
 import jax
