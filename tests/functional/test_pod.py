@@ -221,3 +221,37 @@ def test_coordinator_restart_mid_hunt_with_live_workers(tmp_path):
     finally:
         server_b.terminate()
         server_b.join(timeout=10)
+
+
+def test_hosted_producer_serves_cohort_and_surrogate_algorithms():
+    """The coordinator-hosted producer must drive the generation-cohort
+    (CMA-ES: suggest barriers until the cohort's results arrive over RPC)
+    and surrogate (GP) algorithms end-to-end, not just the stateless ones."""
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+    from metaopt_tpu.executor import InProcessExecutor
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+    from metaopt_tpu.worker import workon
+
+    server = CoordServer().start()
+    host, port = server.address
+    try:
+        for algo in ({"cmaes": {"seed": 0, "population_size": 6}},
+                     {"gp": {"seed": 0, "n_initial_points": 5}}):
+            name = list(algo)[0]
+            ledger = CoordLedgerClient(host=host, port=port)
+            space = build_space({"x": "uniform(-5, 5)", "y": "uniform(-5, 5)"})
+            exp = Experiment(name, ledger, space=space, algorithm=algo,
+                             max_trials=14, pool_size=2).configure()
+            workon(
+                exp,
+                InProcessExecutor(lambda p: [{
+                    "name": "o", "type": "objective",
+                    "value": (p["x"] - 1) ** 2 + (p["y"] + 1) ** 2,
+                }]),
+                worker_id=f"w-{name}",
+                producer_mode="coord",
+            )
+            assert ledger.count(name, "completed") == 14, name
+    finally:
+        server.stop()
