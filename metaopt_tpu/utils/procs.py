@@ -19,6 +19,26 @@ import time
 from typing import Optional, Sequence, Tuple
 
 
+def _drain_fd(fd: int, pos: int) -> Tuple[bytes, int]:
+    """Read everything past ``pos`` from a child's capture temp file.
+
+    pread only: the child writes through a dup of this descriptor (one
+    shared file offset), so a seek here would relocate the child's next
+    write mid-file and corrupt the capture.
+    """
+    chunks = []
+    while True:
+        try:
+            blk = os.pread(fd, 1 << 16, pos)
+        except OSError:
+            break
+        if not blk:
+            break
+        chunks.append(blk)
+        pos += len(blk)
+    return b"".join(chunks), pos
+
+
 def run_with_deadline(
     argv: Sequence[str],
     timeout_s: float,
@@ -51,20 +71,9 @@ def run_with_deadline(
         )
 
         def _drain(pos: int) -> Tuple[bytes, int]:
-            # pread only: the child writes through a dup of this descriptor
-            # (one shared file offset), so a seek here would relocate the
-            # child's next write mid-file and corrupt the capture.
-            chunks = []
-            while out_f is not None:
-                try:
-                    blk = os.pread(out_f.fileno(), 1 << 16, pos)
-                except OSError:
-                    break
-                if not blk:
-                    break
-                chunks.append(blk)
-                pos += len(blk)
-            return b"".join(chunks), pos
+            if out_f is None:
+                return b"", pos
+            return _drain_fd(out_f.fileno(), pos)
 
         def _tee() -> None:
             nonlocal streamed
@@ -102,6 +111,95 @@ def run_with_deadline(
     finally:
         if out_f is not None:
             out_f.close()
+
+
+def run_many_with_deadline(
+    jobs: Sequence[Tuple[str, Sequence[str], Optional[dict]]],
+    timeout_s: float,
+    poll_s: float = 0.5,
+) -> dict:
+    """Run labeled children concurrently under ONE shared deadline.
+
+    ``jobs`` is ``[(label, argv, env), ...]``. Every child's combined
+    stdout+stderr is teed to this process's stdout live, each complete line
+    prefixed ``[label] `` — so an outer observer that kills this process
+    still sees exactly which jobs were in flight and how far each got
+    (same doctrine as ``run_with_deadline(stream=True)``, multiplexed).
+
+    Returns ``{label: (returncode_or_None, full_output)}``; a ``None``
+    returncode means the shared deadline hit and that child was killed.
+    """
+    import codecs
+
+    class _Job:
+        def __init__(self, label, argv, env):
+            self.label = label
+            self.out_f = tempfile.TemporaryFile()
+            self.pos = 0  # bytes already drained
+            self.pending = ""  # partial last line awaiting its newline
+            self.decoder = codecs.getincrementaldecoder("utf-8")("replace")
+            try:
+                self.proc = subprocess.Popen(
+                    argv, env=env, stdout=self.out_f, stderr=subprocess.STDOUT
+                )
+            except BaseException:
+                self.out_f.close()
+                raise
+            self.rc: Optional[int] = None
+
+        def drain(self, final: bool = False) -> None:
+            data, self.pos = _drain_fd(self.out_f.fileno(), self.pos)
+            text = self.pending + self.decoder.decode(data)
+            *lines, self.pending = text.split("\n")
+            for ln in lines:
+                sys.stdout.write(f"[{self.label}] {ln}\n")
+            if final and self.pending:
+                sys.stdout.write(f"[{self.label}] {self.pending}\n")
+                self.pending = ""
+            sys.stdout.flush()
+
+    js: list = []
+    try:
+        # inside the try: a Popen failure for a later job (fork EAGAIN is
+        # plausible exactly when several jax interpreters start at once)
+        # must not leak the already-started children unsupervised
+        for (label, argv, env) in jobs:
+            js.append(_Job(label, argv, env))
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            running = False
+            for j in js:
+                if j.rc is None:
+                    j.rc = j.proc.poll()
+                    j.drain()
+                    running = running or j.rc is None
+            if not running:
+                break
+            time.sleep(poll_s)
+        for j in js:
+            if j.rc is None:
+                j.rc = j.proc.poll()
+            if j.rc is None:
+                j.proc.kill()
+                try:  # non-blocking reap (see run_with_deadline)
+                    j.proc.wait(timeout=2.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            j.drain(final=True)
+        out = {}
+        for j in js:
+            data, _ = _drain_fd(j.out_f.fileno(), 0)
+            out[j.label] = (j.rc, data.decode(errors="replace"))
+        return out
+    finally:
+        for j in js:
+            if j.proc.poll() is None:  # exception paths: no orphans
+                j.proc.kill()
+                try:
+                    j.proc.wait(timeout=2.0)
+                except subprocess.TimeoutExpired:
+                    pass
+            j.out_f.close()
 
 
 def preflight_backend(timeout_s: float = 90.0,
