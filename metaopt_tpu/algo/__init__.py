@@ -14,7 +14,9 @@ population based training with exploit/explore and checkpoint lineage),
 DEHB (differential evolution over the Hyperband ladder), CMAES (the pycma/nevergrad
 plugin family, async generations), GPBO (GP-EI
 Bayesian optimization — the skopt/robo plugin-lineage family — with the
-exact-MLL fit and acquisition as one jitted program), plus the
+exact-MLL fit and acquisition as one jitted program), MOTPE
+(multi-objective TPE: NSGA-II Pareto ordering compressed into a scalar
+pseudo-objective feeding the same fused TPE kernel), plus the
 test-support DumbAlgo.
 """
 
@@ -31,6 +33,7 @@ from metaopt_tpu.algo.pbt import PBT
 from metaopt_tpu.algo.dehb import DEHB
 from metaopt_tpu.algo.gp_bo import GPBO
 from metaopt_tpu.algo.cmaes import CMAES
+from metaopt_tpu.algo.motpe import MOTPE
 
 __all__ = [
     "BaseAlgorithm",
@@ -48,4 +51,5 @@ __all__ = [
     "DEHB",
     "CMAES",
     "GPBO",
+    "MOTPE",
 ]

@@ -134,14 +134,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     plot = sub.add_parser("plot", help="optimization diagnostics")
     plot.add_argument("kind",
-                      choices=["regret", "lcurve", "parallel", "importance"],
+                      choices=["regret", "lcurve", "parallel", "importance",
+                               "pareto"],
                       help="regret: best-objective-so-far per completed "
                            "trial; lcurve: objective vs fidelity budget per "
                            "lineage (multi-fidelity experiments); parallel: "
                            "parallel-coordinates data (params + objective "
                            "per completed trial, JSON); importance: "
                            "per-parameter importance from a fitted ARD GP "
-                           "surrogate (the lineage's LPI role)")
+                           "surrogate (the lineage's LPI role); pareto: "
+                           "nondominated front over the trials' objective "
+                           "vectors (multi-objective experiments)")
     common(plot)
     plot.add_argument("--json", action="store_true", dest="as_json")
 
@@ -642,6 +645,8 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
         return _plot_parallel(args, ledger)
     if args.kind == "importance":
         return _plot_importance(args, ledger)
+    if args.kind == "pareto":
+        return _plot_pareto(args, ledger)
     points = regret_series(ledger, args.name)
     if args.as_json:
         print(json.dumps({"experiment": args.name, "regret": points},
@@ -665,6 +670,52 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
         print(f"{label:>12.4g} |{''.join(row)}")
     print(f"{'':>12} +{'-' * len(bests)}")
     print(f"final best: {bests[-1]:.6g}")
+    return 0
+
+
+def _plot_pareto(args, ledger) -> int:
+    """Nondominated front of a multi-objective experiment.
+
+    ASCII scatter for the first two objectives (front points ``*``,
+    dominated ``.``) or the full front as JSON; the ranking computation is
+    shared with GET /experiments/{name}/pareto and the motpe algorithm.
+    """
+    from metaopt_tpu.io.webapi import pareto_series
+
+    code, payload = pareto_series(ledger, args.name)
+    if code != 200:
+        print(payload.get("error", "pareto front unavailable"))
+        return 1
+    if args.as_json:
+        print(json.dumps(payload, indent=2))
+        return 0
+    front = payload["front"]
+    front_ids = {r["id"] for r in front}
+    all_pts = [(t.objectives[0], t.objectives[1], t.id in front_ids)
+               for t in ledger.fetch(args.name, "completed")
+               if len(t.objectives) >= 2]
+    xs = [p[0] for p in all_pts]
+    ys = [p[1] for p in all_pts]
+    lo_x, hi_x = min(xs), max(xs)
+    lo_y, hi_y = min(ys), max(ys)
+    sx = (hi_x - lo_x) or 1.0
+    sy = (hi_y - lo_y) or 1.0
+    width, height = 56, 14
+    grid = [[" "] * width for _ in range(height)]
+    for x, y, on_front in sorted(all_pts, key=lambda p: p[2]):
+        c = int((x - lo_x) / sx * (width - 1))
+        r = int((hi_y - y) / sy * (height - 1))  # row 0 = objective-2 max
+        grid[r][c] = "*" if on_front else "."
+    print(f"pareto front ({args.name}): {len(front)} nondominated of "
+          f"{payload['trials']} completed trials, "
+          f"{payload['n_objectives']} objectives"
+          + (" (showing the first two)" if payload["n_objectives"] > 2
+             else ""))
+    for r, row in enumerate(grid):
+        label = hi_y - sy * r / (height - 1)
+        print(f"{label:>12.4g} |{''.join(row)}")
+    print(f"{'':>12} +{'-' * width}")
+    print(f"{'':>12}  {lo_x:<.4g}{'':>{max(1, width - 16)}}{hi_x:>.4g}")
     return 0
 
 

@@ -45,13 +45,16 @@ def report_results(data: List[Mapping[str, Any]]) -> None:
     ``{"name": ..., "type": "objective" | "constraint" | "gradient" | "statistic",
        "value": ...}``
 
-    Exactly one ``objective`` entry is required (the scalar being minimized).
+    At least one ``objective`` entry is required. The FIRST one is the
+    scalar single-objective algorithms minimize (reference contract:
+    exactly one); additional objective entries, in report order, form the
+    objective vector consumed by multi-objective algorithms (``motpe``).
     """
     data = [dict(d) for d in data]
     n_obj = sum(1 for d in data if d.get("type") == "objective")
-    if n_obj != 1:
+    if n_obj < 1:
         raise ReportError(
-            f"report_results needs exactly one objective entry, got {n_obj}"
+            f"report_results needs at least one objective entry, got {n_obj}"
         )
     for d in data:
         if not {"name", "type", "value"} <= set(d):

@@ -18,6 +18,8 @@ coordinator's write path:
   per lineage (mtpu plot lcurve)
 - ``GET /experiments/{name}/importance``  → per-parameter importance from
   the ARD GP surrogate (mtpu plot importance)
+- ``GET /experiments/{name}/pareto``      → nondominated front over the
+  trials' objective vectors (mtpu plot pareto; multi-objective runs)
 - ``GET /healthz``                        → liveness
 
 Deliberately read-only: every write still flows through the single-writer
@@ -128,6 +130,43 @@ def importance_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
     imp = ard_importance(X, y)
     return 200, {"experiment": name, "trials": len(done),
                  "importance": dict(zip(space.keys(), imp.tolist()))}
+
+
+def pareto_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
+    """(status, payload) for GET /experiments/{name}/pareto.
+
+    Nondominated front over the completed trials' objective VECTORS
+    (multi-objective runs report several objective-typed results; see
+    Trial.objectives). Shares the ranking computation with the motpe
+    algorithm and `mtpu plot pareto`, so the three surfaces agree.
+    """
+    import numpy as np
+
+    from metaopt_tpu.algo.motpe import nondominated_ranks
+
+    every = [t for t in ledger.fetch(name, "completed") if t.objectives]
+    if not every:
+        return 400, {"error": f"{name!r} has no completed trials with "
+                              "objectives"}
+    # rank only the trials carrying a full vector: one stray short-vector
+    # trial (e.g. a pruned trial's synthesized single objective) must not
+    # disable the endpoint for the whole run — mirror motpe's tolerance
+    done = [t for t in every if len(t.objectives) >= 2]
+    if not done:
+        return 400, {"error": f"{name!r} trials report a single objective; "
+                              "the Pareto front needs at least two "
+                              "(see client.report_results)"}
+    m = min(len(t.objectives) for t in done)
+    F = np.asarray([t.objectives[:m] for t in done], dtype=np.float64)
+    ranks = nondominated_ranks(F)
+    front = [
+        {"id": done[i].id, "params": done[i].params,
+         "objectives": F[i].tolist()}
+        for i in np.where(ranks == 0)[0]
+    ]
+    front.sort(key=lambda r: r["objectives"])
+    return 200, {"experiment": name, "n_objectives": m,
+                 "trials": len(done), "front": front}
 
 
 def lcurve_series(ledger: LedgerBackend, name: str):
@@ -309,7 +348,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "/experiments/{name}/trials", "/experiments/{name}/regret",
                 "/experiments/{name}/lcurves",
                 "/experiments/{name}/parallel",
-                "/experiments/{name}/importance", "/healthz",
+                "/experiments/{name}/importance",
+                "/experiments/{name}/pareto", "/healthz",
             ]}
         if parts == ["healthz"]:
             return 200, {"ok": True}
@@ -345,6 +385,8 @@ class _Handler(BaseHTTPRequestHandler):
                          "trials": rows}
         if parts[2] == "importance":
             return importance_series(ledger, name)
+        if parts[2] == "pareto":
+            return pareto_series(ledger, name)
         return 404, {"error": f"unknown route /{'/'.join(parts)}"}
 
 

@@ -113,6 +113,15 @@ class Trial:
         return None
 
     @property
+    def objectives(self) -> List[float]:
+        """All objective-typed result values, in report order.
+
+        Single-objective algorithms read ``objective`` (the first);
+        multi-objective ones (``motpe``) consume this full vector.
+        """
+        return [float(r.value) for r in self.results if r.type == "objective"]
+
+    @property
     def constraints(self) -> List[Result]:
         return [r for r in self.results if r.type == "constraint"]
 

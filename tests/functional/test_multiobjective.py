@@ -1,0 +1,102 @@
+"""Functional: a multi-objective hunt end-to-end through the real CLI.
+
+Trials report TWO objective-typed results; motpe drives the search; the
+front is served consistently by `mtpu plot pareto` and the read-only web
+API (the two share one computation with the algorithm's own ranking).
+"""
+
+import json
+import os
+import urllib.request
+
+from metaopt_tpu.cli import main as cli_main
+from metaopt_tpu.io.webapi import make_server, start_in_thread
+from metaopt_tpu.ledger.backends import make_ledger
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(os.path.dirname(HERE))
+MULTIOBJ = os.path.join(REPO, "examples", "multiobj.py")
+
+
+def _dominates(a, b):
+    return all(x <= y for x, y in zip(a, b)) and any(
+        x < y for x, y in zip(a, b))
+
+
+class TestMultiObjectiveHunt:
+    def test_motpe_hunt_plot_and_web_agree(self, tmp_path, capsys):
+        ledger_dir = str(tmp_path / "ledger")
+        cfg = tmp_path / "motpe.yaml"
+        cfg.write_text(
+            "algorithm:\n  motpe:\n    seed: 3\n    n_objectives: 2\n"
+            "    n_initial_points: 6\n"
+        )
+        rc = cli_main([
+            "hunt", "-n", "mo", "--ledger", ledger_dir,
+            "--max-trials", "10", "--pool-size", "2",
+            "--config", str(cfg),
+            MULTIOBJ, "-x~uniform(0, 1)", "-y~uniform(0, 1)",
+        ])
+        assert rc == 0
+        capsys.readouterr()
+
+        # every completed trial carries the 2-vector
+        ledger = make_ledger({"type": "file", "path": ledger_dir})
+        done = ledger.fetch("mo", "completed")
+        assert len(done) == 10
+        assert all(len(t.objectives) == 2 for t in done)
+
+        # plot pareto --json: the front is mutually nondominated and
+        # nothing outside it dominates a front member
+        rc = cli_main(["plot", "pareto", "-n", "mo", "--ledger", ledger_dir,
+                       "--json"])
+        assert rc == 0
+        payload = json.loads(capsys.readouterr().out)
+        front = [r["objectives"] for r in payload["front"]]
+        assert front
+        for a in front:
+            assert not any(_dominates(b, a) for b in front if b != a)
+        outside = [t.objectives for t in done
+                   if t.objectives not in front]
+        for a in front:
+            assert not any(_dominates(b, a) for b in outside)
+
+        # ASCII rendering names the front size
+        rc = cli_main(["plot", "pareto", "-n", "mo", "--ledger", ledger_dir])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert f"{len(front)} nondominated of 10" in text
+
+        # the web API serves the identical front
+        server = make_server(ledger, port=0)
+        start_in_thread(server)
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/experiments/mo/pareto"
+            ) as r:
+                web = json.loads(r.read())
+            assert [x["objectives"] for x in web["front"]] == front
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_pareto_route_rejects_single_objective_runs(self, tmp_path,
+                                                        capsys):
+        from metaopt_tpu.io.webapi import pareto_series
+
+        ledger_dir = str(tmp_path / "ledger")
+        cfg = tmp_path / "r.yaml"
+        cfg.write_text("algorithm:\n  random:\n    seed: 1\n")
+        black_box = os.path.join(HERE, "black_box.py")
+        rc = cli_main([
+            "hunt", "-n", "single", "--ledger", ledger_dir,
+            "--max-trials", "3", "--config", str(cfg),
+            black_box, "-x~uniform(-5, 5)",
+        ])
+        assert rc == 0
+        capsys.readouterr()
+        ledger = make_ledger({"type": "file", "path": ledger_dir})
+        code, payload = pareto_series(ledger, "single")
+        assert code == 400
+        assert "single objective" in payload["error"]
