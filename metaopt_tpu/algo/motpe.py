@@ -138,19 +138,22 @@ class MOTPE(TPE):
                 self._keys_dirty = False
 
     def _observe_one(self, trial: Trial) -> None:
-        objs = trial.objectives
-        if len(objs) < self.n_objectives:
-            # a short vector cannot be ranked against the others; fitting a
-            # zero-padded stand-in would silently bend the front, so the
-            # trial stays observed (replay-idempotent) but unfitted
+        objs = [float(v) for v in trial.objectives[: self.n_objectives]]
+        if len(objs) < self.n_objectives or not np.all(np.isfinite(objs)):
+            # a short vector cannot be ranked against the others, and a
+            # NaN one would rank UNbeatable (all NaN comparisons are False
+            # → never dominated → permanent front-0 with the best key) —
+            # the opposite of scalar TPE, where argsort sends NaN to the
+            # bad set. Either way the trial stays observed
+            # (replay-idempotent) but unfitted.
             log.warning(
-                "motpe: trial %s reported %d objectives, need %d — "
-                "excluded from the Parzen fit", trial.id, len(objs),
+                "motpe: trial %s reported objectives %r, need %d finite — "
+                "excluded from the Parzen fit", trial.id, trial.objectives,
                 self.n_objectives,
             )
             return
         self._X.append(self.cube.transform(trial.params))
-        self._F.append([float(v) for v in objs[: self.n_objectives]])
+        self._F.append(objs)
         self._keys_dirty = True
 
     def _rebuild_keys(self) -> None:
@@ -176,14 +179,17 @@ class MOTPE(TPE):
 
     # -- persistence -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
-        s = super().state_dict()
+        # ONE lock acquisition around both snapshots (RLock nests): a
+        # concurrent observe() between them would serialize an F one row
+        # longer than X/y, and restoring that state crashes _sync_device
         with self._kernel_lock:
+            s = super().state_dict()
             s["F"] = [list(f) for f in self._F]
         return s
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
-        super().load_state_dict(state)
         with self._kernel_lock:
+            super().load_state_dict(state)
             self._F = [list(f) for f in state.get("F", [])]
             if self._F:
                 # the serialized y is the pseudo-objective (derived data);

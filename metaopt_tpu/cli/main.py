@@ -156,16 +156,31 @@ def build_parser() -> argparse.ArgumentParser:
                          "(replays completed trials into the algorithm)")
 
     db = sub.add_parser("db", help="ledger backend utilities")
-    db.add_argument("action", choices=["test", "rm", "compact"],
+    db.add_argument("action", choices=["test", "rm", "compact", "dump",
+                                       "load"],
                     help="test: drive the full backend contract (create, "
                          "dup-detect, reserve CAS, heartbeat, stale "
                          "release) against the configured ledger; "
                          "rm: delete an experiment and its trials; "
                          "compact: rewrite a native ledger's append-only "
-                         "log to its live state (reclaims heartbeat spam)")
-    db.add_argument("-n", "--name", help="experiment to delete (rm)")
+                         "log to its live state (reclaims heartbeat spam); "
+                         "dump: archive experiments + trials to portable "
+                         "JSON; load: restore an archive into the "
+                         "configured ledger")
+    db.add_argument("-n", "--name",
+                    help="experiment to delete (rm) / archive (dump; "
+                         "default all)")
     db.add_argument("--force", action="store_true",
                     help="rm: required to actually delete")
+    db.add_argument("-o", "--output",
+                    help="dump: write the archive here (default stdout)")
+    db.add_argument("--file", help="load: the archive file to restore")
+    db.add_argument("--resolve", choices=["fail", "ignore", "overwrite",
+                                          "bump"], default="fail",
+                    help="load: name-collision policy — fail (default), "
+                         "ignore (skip existing), overwrite (replace doc + "
+                         "trials), bump (load as NAME-vN with version+1 and "
+                         "parent set, the EVC-style sibling)")
     db.add_argument("--json", action="store_true", dest="as_json",
                     help="test: emit the check report as JSON")
     db.add_argument("--config", help="framework config YAML")
@@ -690,10 +705,11 @@ def _plot_pareto(args, ledger) -> int:
         print(json.dumps(payload, indent=2))
         return 0
     front = payload["front"]
-    front_ids = {r["id"] for r in front}
-    all_pts = [(t.objectives[0], t.objectives[1], t.id in front_ids)
-               for t in ledger.fetch(args.name, "completed")
-               if len(t.objectives) >= 2]
+    # one consistent snapshot: the payload carries the dominated points
+    # too, so the scatter needs no second (racy) ledger read
+    all_pts = ([(r["objectives"][0], r["objectives"][1], True)
+                for r in front]
+               + [(o[0], o[1], False) for o in payload["dominated"]])
     xs = [p[0] for p in all_pts]
     ys = [p[1] for p in all_pts]
     lo_x, hi_x = min(xs), max(xs)
@@ -814,6 +830,106 @@ def _plot_lcurve(args, ledger) -> int:
     return 0
 
 
+#: the dump/load interchange format marker (ref: the lineage's
+#: `orion db dump` / `db load` archive tooling, re-based from a pickled
+#: database onto portable JSON so archives move between ANY two ledger
+#: backends — memory/file/native/coord — and survive version skew legibly)
+_ARCHIVE_FORMAT = "metaopt-tpu-archive"
+
+
+def _db_dump(args, ledger) -> int:
+    """Archive experiments (document + every trial) as one JSON file."""
+    names = [args.name] if args.name else sorted(ledger.list_experiments())
+    experiments = []
+    for name in names:
+        doc = ledger.load_experiment(name)
+        if doc is None:
+            raise SystemExit(f"no such experiment: {name}")
+        experiments.append({
+            "document": doc,
+            "trials": [t.to_dict() for t in ledger.fetch(name)],
+        })
+    archive = {"format": _ARCHIVE_FORMAT, "version": 1,
+               "experiments": experiments}
+    text = json.dumps(archive, indent=2)
+    if args.output:
+        tmp = args.output + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, args.output)  # atomic: never a torn archive
+        n_trials = sum(len(e["trials"]) for e in experiments)
+        print(f"dumped {len(experiments)} experiment(s), {n_trials} "
+              f"trial(s) to {args.output}")
+    else:
+        print(text)
+    return 0
+
+
+def _db_load(args, ledger) -> int:
+    """Restore a dump archive into the configured ledger.
+
+    Collision policy per --resolve: fail | ignore | overwrite | bump
+    (bump loads as ``NAME-vN`` with version+1 and ``parent`` set — the
+    ledger keys experiments by name, so a version bump is an EVC-style
+    sibling, not an in-place rewrite).
+    """
+    from metaopt_tpu.ledger.backends import DuplicateTrialError
+    from metaopt_tpu.ledger.trial import Trial
+
+    if not args.file:
+        raise SystemExit("db load needs --file ARCHIVE")
+    with open(args.file) as f:
+        archive = json.load(f)
+    if archive.get("format") != _ARCHIVE_FORMAT:
+        raise SystemExit(
+            f"{args.file}: not a {_ARCHIVE_FORMAT} file "
+            f"(format={archive.get('format')!r})"
+        )
+    for entry in archive.get("experiments", []):
+        doc = dict(entry["document"])
+        name = doc.get("name")
+        if not name:
+            raise SystemExit(f"{args.file}: experiment entry without a name")
+        existing = ledger.load_experiment(name)
+        if existing is not None:
+            if args.resolve == "fail":
+                raise SystemExit(
+                    f"experiment {name!r} already exists; re-run with "
+                    "--resolve ignore|overwrite|bump"
+                )
+            if args.resolve == "ignore":
+                print(f"{name}: exists, skipped")
+                continue
+            if args.resolve == "overwrite":
+                if not ledger.delete_experiment(name):
+                    raise SystemExit(
+                        f"backend {type(ledger).__name__} cannot overwrite "
+                        f"{name!r} (no deletion support)"
+                    )
+            elif args.resolve == "bump":
+                version = int(existing.get("version", 1)) + 1
+                bumped = f"{name}-v{version}"
+                if ledger.load_experiment(bumped) is not None:
+                    raise SystemExit(
+                        f"bump target {bumped!r} already exists; "
+                        "rm it or dump/load under another name"
+                    )
+                doc.update(name=bumped, version=version, parent=name)
+                name = bumped
+        ledger.create_experiment(doc)
+        loaded = dups = 0
+        for tdoc in entry.get("trials", []):
+            t = Trial.from_dict({**tdoc, "experiment": name})
+            try:
+                ledger.register(t)
+                loaded += 1
+            except DuplicateTrialError:
+                dups += 1  # partially-loaded archive re-applied: idempotent
+        note = f" ({dups} already present)" if dups else ""
+        print(f"{name}: loaded document + {loaded} trial(s){note}")
+    return 0
+
+
 def _cmd_db(args, cfg: Dict[str, Any]) -> int:
     """ref: the lineage's `db test` — validate a live backend end-to-end.
 
@@ -829,6 +945,10 @@ def _cmd_db(args, cfg: Dict[str, Any]) -> int:
     )
 
     ledger = _make_ledger_from_spec(args.ledger, cfg)
+    if args.action == "dump":
+        return _db_dump(args, ledger)
+    if args.action == "load":
+        return _db_load(args, ledger)
     if args.action == "compact":
         if not hasattr(ledger, "compact"):
             raise SystemExit(

@@ -148,15 +148,26 @@ def pareto_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
     if not every:
         return 400, {"error": f"{name!r} has no completed trials with "
                               "objectives"}
-    # rank only the trials carrying a full vector: one stray short-vector
-    # trial (e.g. a pruned trial's synthesized single objective) must not
-    # disable the endpoint for the whole run — mirror motpe's tolerance
-    done = [t for t in every if len(t.objectives) >= 2]
-    if not done:
+    # the vector length to rank in: the motpe config's n_objectives when
+    # the experiment ran motpe, else the longest reported vector. Trials
+    # with fewer (or non-finite) objectives are EXCLUDED, exactly like
+    # motpe._observe_one — truncating everyone to the shortest vector
+    # would instead drop points that are nondominated only via the
+    # missing dimension, silently disagreeing with the algorithm's front.
+    doc = ledger.load_experiment(name) or {}
+    m = (doc.get("algorithm", {}).get("motpe", {}) or {}).get("n_objectives")
+    if not m:
+        m = max(len(t.objectives) for t in every)
+    if m < 2:
         return 400, {"error": f"{name!r} trials report a single objective; "
                               "the Pareto front needs at least two "
                               "(see client.report_results)"}
-    m = min(len(t.objectives) for t in done)
+    done = [t for t in every
+            if len(t.objectives) >= m
+            and np.all(np.isfinite(t.objectives[:m]))]
+    if not done:
+        return 400, {"error": f"{name!r} has no completed trials with "
+                              f"{m} finite objectives"}
     F = np.asarray([t.objectives[:m] for t in done], dtype=np.float64)
     ranks = nondominated_ranks(F)
     front = [
@@ -165,8 +176,12 @@ def pareto_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
         for i in np.where(ranks == 0)[0]
     ]
     front.sort(key=lambda r: r["objectives"])
+    # dominated points ride along so renderers (the CLI scatter) get one
+    # consistent snapshot instead of a second racy ledger read
+    dominated = sorted(F[i].tolist() for i in np.where(ranks > 0)[0])
     return 200, {"experiment": name, "n_objectives": m,
-                 "trials": len(done), "front": front}
+                 "trials": len(done), "front": front,
+                 "dominated": dominated}
 
 
 def lcurve_series(ledger: LedgerBackend, name: str):

@@ -81,6 +81,39 @@ class TestMultiObjectiveHunt:
             server.shutdown()
             server.server_close()
 
+    def test_pareto_route_excludes_short_vectors_not_truncates(self,
+                                                               tmp_path):
+        # a 3-objective run with one 2-vector straggler: the straggler is
+        # EXCLUDED (matching motpe), not used to truncate everyone to 2-D
+        from metaopt_tpu.io.webapi import pareto_series
+        from metaopt_tpu.ledger.trial import Trial
+
+        ledger = make_ledger({"type": "file",
+                              "path": str(tmp_path / "ledger")})
+        ledger.create_experiment({"name": "m3", "space": {}, "version": 1,
+                                  "algorithm": {"random": {}}})
+
+        def add(objs, _n=[0]):
+            _n[0] += 1
+            t = Trial(params={"x": float(_n[0])}, experiment="m3")
+            t.transition("reserved")
+            t.attach_results([{"name": f"o{i}", "type": "objective",
+                               "value": v} for i, v in enumerate(objs)])
+            t.transition("completed")
+            ledger.register(t)
+
+        # b is nondominated ONLY via the 3rd objective; 2-D truncation
+        # would wrongly report it dominated by a
+        add([1.0, 1.0, 5.0])          # a
+        add([1.0, 1.0, 1.0])          # b
+        add([2.0, 2.0])               # straggler: excluded from ranking
+        code, payload = pareto_series(ledger, "m3")
+        assert code == 200
+        assert payload["n_objectives"] == 3 and payload["trials"] == 2
+        fronts = [r["objectives"] for r in payload["front"]]
+        assert [1.0, 1.0, 1.0] in fronts
+        assert payload["dominated"] == [[1.0, 1.0, 5.0]]
+
     def test_pareto_route_rejects_single_objective_runs(self, tmp_path,
                                                         capsys):
         from metaopt_tpu.io.webapi import pareto_series

@@ -1,0 +1,104 @@
+"""`mtpu db dump` / `db load`: portable experiment archives.
+
+ref: the lineage's `orion db dump` / `db load` tooling — archive an
+experiment (document + trials) and restore it into any ledger backend,
+with the fail/ignore/overwrite/bump collision policies.
+"""
+
+import json
+
+import pytest
+
+from metaopt_tpu.cli import main as cli_main
+from metaopt_tpu.ledger.backends import make_ledger
+from metaopt_tpu.ledger.trial import Trial
+
+
+def seed_experiment(ledger, name="src", n=3):
+    ledger.create_experiment({
+        "name": name, "space": {"x": "uniform(0, 1)"},
+        "algorithm": {"random": {"seed": 1}}, "max_trials": n, "version": 1,
+    })
+    for i in range(n):
+        t = Trial(params={"x": i / 10}, experiment=name)
+        t.transition("reserved")
+        t.attach_results(
+            [{"name": "o", "type": "objective", "value": float(i)}]
+        )
+        t.transition("completed")
+        ledger.register(t)
+
+
+class TestDumpLoad:
+    def test_roundtrip_between_file_ledgers(self, tmp_path, capsys):
+        src = str(tmp_path / "src")
+        dst = str(tmp_path / "dst")
+        arch = str(tmp_path / "arch.json")
+        seed_experiment(make_ledger({"type": "file", "path": src}))
+
+        rc = cli_main(["db", "dump", "-n", "src", "--ledger", src,
+                       "-o", arch])
+        assert rc == 0
+        assert "1 experiment(s), 3 trial(s)" in capsys.readouterr().out
+
+        rc = cli_main(["db", "load", "--file", arch, "--ledger", dst])
+        assert rc == 0
+        assert "loaded document + 3 trial(s)" in capsys.readouterr().out
+
+        restored = make_ledger({"type": "file", "path": dst})
+        doc = restored.load_experiment("src")
+        assert doc["max_trials"] == 3 and doc["space"] == {"x": "uniform(0, 1)"}
+        done = restored.fetch("src", "completed")
+        assert sorted(t.objective for t in done) == [0.0, 1.0, 2.0]
+
+    def test_dump_all_to_stdout(self, tmp_path, capsys):
+        src = str(tmp_path / "src")
+        ledger = make_ledger({"type": "file", "path": src})
+        seed_experiment(ledger, "a", n=1)
+        seed_experiment(ledger, "b", n=2)
+        rc = cli_main(["db", "dump", "--ledger", src])
+        assert rc == 0
+        archive = json.loads(capsys.readouterr().out)
+        assert archive["format"] == "metaopt-tpu-archive"
+        assert [e["document"]["name"] for e in archive["experiments"]] \
+            == ["a", "b"]
+
+    def test_collision_policies(self, tmp_path, capsys):
+        src = str(tmp_path / "src")
+        arch = str(tmp_path / "arch.json")
+        ledger = make_ledger({"type": "file", "path": src})
+        seed_experiment(ledger)
+        cli_main(["db", "dump", "-n", "src", "--ledger", src, "-o", arch])
+        capsys.readouterr()
+
+        # default: refuse to clobber
+        with pytest.raises(SystemExit, match="already exists"):
+            cli_main(["db", "load", "--file", arch, "--ledger", src])
+
+        # ignore: no-op on existing
+        rc = cli_main(["db", "load", "--file", arch, "--ledger", src,
+                       "--resolve", "ignore"])
+        assert rc == 0
+        assert "skipped" in capsys.readouterr().out
+        assert ledger.count("src") == 3
+
+        # overwrite: replaces document + trials (same counts, fresh load)
+        rc = cli_main(["db", "load", "--file", arch, "--ledger", src,
+                       "--resolve", "overwrite"])
+        assert rc == 0
+        assert ledger.count("src") == 3
+
+        # bump: EVC-style sibling with version+1 and parent set
+        rc = cli_main(["db", "load", "--file", arch, "--ledger", src,
+                       "--resolve", "bump"])
+        assert rc == 0
+        bumped = ledger.load_experiment("src-v2")
+        assert bumped["version"] == 2 and bumped["parent"] == "src"
+        assert ledger.count("src-v2") == 3
+
+    def test_load_rejects_foreign_json(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"hello": "world"}))
+        with pytest.raises(SystemExit, match="not a metaopt-tpu-archive"):
+            cli_main(["db", "load", "--file", str(bad),
+                      "--ledger", str(tmp_path / "dst")])

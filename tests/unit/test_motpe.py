@@ -101,6 +101,16 @@ class TestAlgorithm:
         mo.observe([completed(space, {"x": 2.0}, [1.0, 2.0])])
         assert len(mo._F) == 1
 
+    def test_nan_vector_excluded_from_fit(self):
+        # all NaN comparisons are False → a NaN point would be permanently
+        # nondominated with the best key; it must be excluded instead
+        space, mo = make_motpe()
+        mo.observe([completed(space, {"x": 1.0}, [float("nan"), 0.1]),
+                    completed(space, {"x": 2.0}, [1.0, 2.0])])
+        assert mo.n_observed == 2
+        assert mo._F == [[1.0, 2.0]]
+        assert len(mo.pareto_front()) == 1
+
     def test_pareto_front_accessor(self):
         space, mo = make_motpe()
         mo.observe([completed(space, {"x": 1.0}, [1.0, 3.0]),
