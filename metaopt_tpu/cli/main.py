@@ -885,6 +885,13 @@ def _db_load(args, ledger) -> int:
             f"{args.file}: not a {_ARCHIVE_FORMAT} file "
             f"(format={archive.get('format')!r})"
         )
+    if archive.get("version") != 1:
+        # a future format revision must fail loudly here, not "succeed"
+        # with silently-dropped fields
+        raise SystemExit(
+            f"{args.file}: archive version {archive.get('version')!r} "
+            "is not supported by this release (expected 1)"
+        )
     for entry in archive.get("experiments", []):
         doc = dict(entry["document"])
         name = doc.get("name")

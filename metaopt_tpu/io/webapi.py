@@ -149,15 +149,21 @@ def pareto_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
         return 400, {"error": f"{name!r} has no completed trials with "
                               "objectives"}
     # the vector length to rank in: the motpe config's n_objectives when
-    # the experiment ran motpe, else the longest reported vector. Trials
-    # with fewer (or non-finite) objectives are EXCLUDED, exactly like
-    # motpe._observe_one — truncating everyone to the shortest vector
-    # would instead drop points that are nondominated only via the
-    # missing dimension, silently disagreeing with the algorithm's front.
+    # the experiment ran motpe (constructor default 2 when the key is
+    # omitted — the algorithm truncates to it, so the surface must too),
+    # else the MODAL reported length (ties → longer): one stray long- or
+    # short-vector trial must never redefine the run's dimensionality.
+    # Trials with fewer (or non-finite) objectives are then EXCLUDED,
+    # exactly like motpe._observe_one — truncating everyone to the
+    # shortest vector would instead drop points that are nondominated
+    # only via the missing dimension.
     doc = ledger.load_experiment(name) or {}
-    m = (doc.get("algorithm", {}).get("motpe", {}) or {}).get("n_objectives")
-    if not m:
-        m = max(len(t.objectives) for t in every)
+    algo_cfg = doc.get("algorithm") or {}
+    if "motpe" in algo_cfg:
+        m = int((algo_cfg["motpe"] or {}).get("n_objectives", 2))
+    else:
+        lengths = [len(t.objectives) for t in every]
+        m = max(set(lengths), key=lambda n: (lengths.count(n), n))
     if m < 2:
         return 400, {"error": f"{name!r} trials report a single objective; "
                               "the Pareto front needs at least two "

@@ -114,6 +114,32 @@ class TestMultiObjectiveHunt:
         assert [1.0, 1.0, 1.0] in fronts
         assert payload["dominated"] == [[1.0, 1.0, 5.0]]
 
+    def test_pareto_route_modal_length_beats_stray_long_vector(self,
+                                                               tmp_path):
+        # one double-reporting trial with a 3-vector must not redefine a
+        # 2-objective run's dimensionality (and so evict every 2-vector)
+        from metaopt_tpu.io.webapi import pareto_series
+        from metaopt_tpu.ledger.trial import Trial
+
+        ledger = make_ledger({"type": "file",
+                              "path": str(tmp_path / "ledger")})
+        ledger.create_experiment({"name": "m2", "space": {}, "version": 1,
+                                  "algorithm": {"random": {}}})
+        for i, objs in enumerate(
+                [[1.0, 2.0], [2.0, 1.0], [3.0, 3.0], [0.5, 0.5, 0.5]]):
+            t = Trial(params={"x": float(i)}, experiment="m2")
+            t.transition("reserved")
+            t.attach_results([{"name": f"o{j}", "type": "objective",
+                               "value": v} for j, v in enumerate(objs)])
+            t.transition("completed")
+            ledger.register(t)
+        code, payload = pareto_series(ledger, "m2")
+        assert code == 200
+        assert payload["n_objectives"] == 2  # modal length, not max
+        assert payload["trials"] == 4        # the 3-vector ranks truncated
+        fronts = [r["objectives"] for r in payload["front"]]
+        assert fronts == [[0.5, 0.5]]  # truncated stray dominates in 2-D
+
     def test_pareto_route_rejects_single_objective_runs(self, tmp_path,
                                                         capsys):
         from metaopt_tpu.io.webapi import pareto_series

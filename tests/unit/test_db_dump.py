@@ -102,3 +102,14 @@ class TestDumpLoad:
         with pytest.raises(SystemExit, match="not a metaopt-tpu-archive"):
             cli_main(["db", "load", "--file", str(bad),
                       "--ledger", str(tmp_path / "dst")])
+
+    def test_load_rejects_future_archive_version(self, tmp_path):
+        # a v2 archive must fail loudly, not "restore" silently-dropped
+        # fields
+        future = tmp_path / "future.json"
+        future.write_text(json.dumps({
+            "format": "metaopt-tpu-archive", "version": 2, "experiments": [],
+        }))
+        with pytest.raises(SystemExit, match="version 2"):
+            cli_main(["db", "load", "--file", str(future),
+                      "--ledger", str(tmp_path / "dst")])
