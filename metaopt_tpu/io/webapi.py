@@ -248,6 +248,8 @@ svg text { fill: var(--muted); font-size: 11px; }
 <tbody></tbody></table>
 <h2 id="chart-title" hidden></h2>
 <div id="chart"></div>
+<h2 id="pareto-title" hidden></h2>
+<div id="pareto"></div>
 <script>
 const W=640, H=220, PAD=42;
 async function j(u){ const r=await fetch(u); return r.json(); }
@@ -316,9 +318,59 @@ async function refresh(){
     document.getElementById('status').textContent='unreachable: '+err;
   }
 }
+function drawPareto(name, front, dominated){
+  // objective-1 vs objective-2 scatter: dominated points recessive,
+  // front points in the accent hue joined by a step line
+  const t=document.getElementById('pareto-title');
+  t.hidden=false;
+  t.textContent=name+' — pareto front ('+front.length+' nondominated)';
+  const pts=front.map(r=>r.objectives).concat(dominated);
+  const xs=pts.map(p=>p[0]), ys=pts.map(p=>p[1]);
+  const xmin=Math.min(...xs), xmax=Math.max(...xs);
+  const ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const xr=(xmax-xmin)||1, yr=(ymax-ymin)||1;
+  const X=v=>PAD+(v-xmin)/xr*(W-2*PAD), Y=v=>H-PAD-(v-ymin)/yr*(H-2*PAD);
+  let g='';
+  for(const t2 of [ymin, ymax]){
+    g+=`<line x1="${PAD}" y1="${Y(t2)}" x2="${W-PAD}" y2="${Y(t2)}"
+         stroke="var(--grid)" stroke-width="1"/>
+        <text x="4" y="${Y(t2)+4}">${fmt(t2)}</text>`;}
+  const dots=dominated.map(p=>
+    `<circle cx="${X(p[0])}" cy="${Y(p[1])}" r="2.5"
+       fill="var(--muted)" opacity="0.45"><title>${fmt(p[0])}, ${fmt(p[1])}
+       </title></circle>`).join('');
+  const fsorted=front.map(r=>r.objectives)
+    .slice().sort((a,b)=>a[0]-b[0]);
+  const fline=fsorted.map(p=>X(p[0])+','+Y(p[1])).join(' ');
+  const fdots=front.map(r=>
+    `<circle cx="${X(r.objectives[0])}" cy="${Y(r.objectives[1])}" r="3.5"
+       fill="var(--accent)"><title>${esc(JSON.stringify(r.params))} →
+       ${r.objectives.map(fmt).join(', ')}</title></circle>`).join('');
+  document.getElementById('pareto').innerHTML=
+   `<svg width="${W}" height="${H}" role="img"
+         aria-label="pareto front for ${esc(name)}">
+      ${g}
+      <polyline points="${fline}" fill="none" stroke="var(--accent)"
+                stroke-width="1.5" stroke-dasharray="4 3"/>
+      ${dots}${fdots}
+      <text x="${PAD}" y="${H-6}">obj1 ${fmt(xmin)}</text>
+      <text x="${W-PAD-52}" y="${H-6}">${fmt(xmax)}</text>
+    </svg>`;
+}
 async function show(name){
   const r=await j('/experiments/'+encodeURIComponent(name)+'/regret');
+  if(name!==selected) return;  // a newer click superseded this fetch
   drawRegret(name, (r.regret||[]).map(d=>[d.trial, d.best]));
+  // multi-objective runs additionally get the front scatter; a 400 from
+  // a single-objective run just hides the section
+  try{
+    const p=await j('/experiments/'+encodeURIComponent(name)+'/pareto');
+    if(name!==selected) return;  // stale response: don't draw A under B
+    if(p.front){ drawPareto(name, p.front, p.dominated||[]); return; }
+  }catch(e){}
+  if(name!==selected) return;
+  document.getElementById('pareto-title').hidden=true;
+  document.getElementById('pareto').innerHTML='';
 }
 refresh(); setInterval(refresh, 5000);
 </script></body></html>"""

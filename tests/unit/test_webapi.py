@@ -130,6 +130,8 @@ def test_dashboard_serves_html(served):
     # SVG, and never references an external asset
     assert "/experiments" in body and "svg" in body.lower()
     assert "http://" not in body.split("<body>")[1]  # no external fetches
+    # the pareto section rides the same page (drawn when /pareto is 200)
+    assert "drawPareto" in body and 'id="pareto"' in body
 
 
 def test_importance_endpoint_needs_trials(served):
