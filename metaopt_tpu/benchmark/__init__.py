@@ -11,11 +11,14 @@ from metaopt_tpu.benchmark.assessments import (
     Assessment,
     AverageRank,
     AverageResult,
+    Hypervolume,
+    hypervolume_2d,
 )
 from metaopt_tpu.benchmark.benchmark import Benchmark, Study
 from metaopt_tpu.benchmark.tasks import (
     BenchmarkTask,
     Branin,
+    ZDT1,
     Rastrigin,
     RosenBrock,
     Sphere,
@@ -26,12 +29,15 @@ __all__ = [
     "Assessment",
     "AverageRank",
     "AverageResult",
+    "Hypervolume",
+    "hypervolume_2d",
     "Benchmark",
     "BenchmarkTask",
     "Branin",
     "Rastrigin",
     "RosenBrock",
     "Sphere",
+    "ZDT1",
     "Study",
     "task_registry",
 ]

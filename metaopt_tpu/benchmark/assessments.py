@@ -13,12 +13,20 @@ from typing import Any, Dict, List
 class Assessment:
     """Turns {algorithm: [series per repetition]} into an analysis dict.
 
-    A series is the best-so-far objective per completed-trial index (one
-    list per repetition, produced by the Benchmark's runs).
+    A series is one float per completed-trial index (one list per
+    repetition). What that float *is* belongs to the assessment:
+    best-so-far objective by default (:meth:`series`), hypervolume-so-far
+    for :class:`Hypervolume`.
     """
 
     #: how many independent repetitions the benchmark should run
     repetitions: int = 1
+
+    def series(self, ledger, exp_name: str, task=None) -> List[float]:
+        """Extract one repetition's progress series from the ledger."""
+        from metaopt_tpu.io.webapi import regret_series
+
+        return [p["best"] for p in regret_series(ledger, exp_name)]
 
     def analyze(
         self, series: Dict[str, List[List[float]]]
@@ -64,6 +72,93 @@ class AverageResult(Assessment):
             "final_best": final,
             "winner": ranked[0] if ranked else None,
         }
+
+
+def hypervolume_2d(points: List[List[float]],
+                   reference: List[float]) -> float:
+    """Exact 2-D hypervolume dominated by ``points`` w.r.t. ``reference``.
+
+    Both objectives minimized; points at or beyond the reference
+    contribute nothing. O(n log n): sort the nondominated set by f1 and
+    sum the staircase slabs.
+    """
+    r1, r2 = float(reference[0]), float(reference[1])
+    pts = sorted((float(p[0]), float(p[1])) for p in points
+                 if p[0] < r1 and p[1] < r2)
+    hv = 0.0
+    best_f2 = r2  # f2 level of the staircase so far
+    for f1, f2 in pts:  # ascending f1: only improving f2 adds area
+        if f2 < best_f2:
+            hv += (r1 - f1) * (best_f2 - f2)
+            best_f2 = f2
+    return hv
+
+
+class Hypervolume(Assessment):
+    """Mean hypervolume-so-far per trial index (multi-objective studies).
+
+    The series value at index i is the hypervolume of the nondominated
+    set of the first i+1 completed trials, w.r.t. a fixed reference
+    point — the task's declared ``reference_point`` (so every algorithm
+    in a study is scored against the same box) unless one is given here.
+    HIGHER is better; `winner` is the argmax of the final mean HV.
+    Exact 2-D computation; tasks with more objectives are scored on
+    their first two.
+    """
+
+    def __init__(self, repetitions: int = 3,
+                 reference_point: List[float] = None):
+        self.repetitions = int(repetitions)
+        self.reference_point = reference_point
+        #: the box actually used (task-declared when ours is None) —
+        #: recorded so the report never claims "reference_point": null
+        #: for numbers that are meaningless without it
+        self._resolved_reference: List[float] = reference_point
+
+    def resolve_reference(self, task=None) -> List[float]:
+        ref = self.reference_point
+        if ref is None:
+            ref = getattr(task, "reference_point", None)
+        if ref is None:
+            raise ValueError(
+                "Hypervolume needs a reference_point (on the assessment "
+                f"or the task; {getattr(task, 'name', task)!r} declares "
+                "none)"
+            )
+        return list(ref)
+
+    def series(self, ledger, exp_name: str, task=None) -> List[float]:
+        from metaopt_tpu.io.webapi import completed_in_order
+
+        ref = self.resolve_reference(task)
+        self._resolved_reference = ref
+        out, pts = [], []
+        for t in completed_in_order(ledger, exp_name):
+            if len(t.objectives) < 2:
+                continue
+            pts.append(t.objectives[:2])
+            out.append(hypervolume_2d(pts, ref))
+        return out
+
+    def analyze(self, series):
+        curves = {algo: _mean_curves(runs) for algo, runs in series.items()}
+        final = {algo: (curve[-1] if curve else None)
+                 for algo, curve in curves.items()}
+        ranked = sorted((a for a, v in final.items() if v is not None),
+                        key=final.get, reverse=True)  # higher HV wins
+        return {
+            "assessment": "hypervolume",
+            "repetitions": self.repetitions,
+            "reference_point": self._resolved_reference,
+            "curves": curves,
+            "final_hypervolume": final,
+            "winner": ranked[0] if ranked else None,
+        }
+
+    @property
+    def configuration(self):
+        return {self.name: {"repetitions": self.repetitions,
+                            "reference_point": self.reference_point}}
 
 
 class AverageRank(Assessment):

@@ -18,7 +18,6 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 from metaopt_tpu.benchmark.assessments import Assessment
 from metaopt_tpu.benchmark.tasks import BenchmarkTask
 from metaopt_tpu.executor import InProcessExecutor
-from metaopt_tpu.io.webapi import regret_series
 from metaopt_tpu.ledger import Experiment, MemoryLedger
 from metaopt_tpu.ledger.backends import LedgerBackend
 from metaopt_tpu.worker import workon
@@ -107,7 +106,10 @@ class Benchmark:
             metadata={"benchmark": self.name},
         ).configure()
         workon(exp, InProcessExecutor(study.task), worker_id=exp_name)
-        return [p["best"] for p in regret_series(self.ledger, exp_name)]
+        # the assessment owns what "progress" means: best-so-far objective
+        # by default, hypervolume-so-far for multi-objective studies
+        return study.assessment.series(self.ledger, exp_name,
+                                       task=study.task)
 
     def process(self) -> None:
         """Run every (study × algorithm × repetition) experiment."""

@@ -110,6 +110,43 @@ class Sphere(BenchmarkTask):
         return {self.name: {"max_trials": self.max_trials, "dim": self.dim}}
 
 
+@task_registry.register("zdt1")
+class ZDT1(BenchmarkTask):
+    """The classic bi-objective ZDT1 trade-off (both minimized).
+
+    f1 = x0; g = 1 + 9·mean(x1..x_{d−1}); f2 = g·(1 − √(f1/g)).
+    The Pareto set is x1..x_{d−1} = 0 with x0 sweeping [0, 1]; the true
+    front is f2 = 1 − √f1. ``reference_point`` bounds the attainable
+    region (f1 ≤ 1, f2 ≤ 10 at d=2) so hypervolume is comparable across
+    algorithms.
+    """
+
+    #: fixed box for the Hypervolume assessment
+    reference_point = [1.0, 10.0]
+
+    def __init__(self, max_trials: int = 40, dim: int = 2):
+        super().__init__(max_trials)
+        self.dim = int(dim)
+
+    @property
+    def space(self) -> Dict[str, str]:
+        return {f"x{i}": "uniform(0, 1)" for i in range(self.dim)}
+
+    def __call__(self, params):
+        f1 = float(params["x0"])
+        tail = [params[f"x{i}"] for i in range(1, self.dim)]
+        g = 1.0 + 9.0 * (sum(tail) / len(tail) if tail else 0.0)
+        f2 = g * (1.0 - math.sqrt(max(f1, 0.0) / g))
+        return [
+            {"name": "f1", "type": "objective", "value": f1},
+            {"name": "f2", "type": "objective", "value": f2},
+        ]
+
+    @property
+    def configuration(self):
+        return {self.name: {"max_trials": self.max_trials, "dim": self.dim}}
+
+
 @task_registry.register("rastrigin")
 class Rastrigin(BenchmarkTask):
     """f(x) = 10d + Σ (x_i² − 10 cos 2πx_i); highly multimodal, min 0."""

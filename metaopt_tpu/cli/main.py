@@ -206,13 +206,17 @@ def build_parser() -> argparse.ArgumentParser:
     bm.add_argument("--algos", nargs="+", default=["random", "tpe"],
                     help="algorithm names, e.g. --algos random tpe gp")
     bm.add_argument("--task", default="rosenbrock",
-                    help="benchmark task (rosenbrock/branin/sphere/rastrigin)")
+                    help="benchmark task (rosenbrock/branin/sphere/"
+                         "rastrigin/zdt1)")
     bm.add_argument("--max-trials", type=int, default=25,
                     help="trial budget per repetition")
     bm.add_argument("--repetitions", type=int, default=3)
-    bm.add_argument("--assessment", choices=("result", "rank"),
+    bm.add_argument("--assessment", choices=("result", "rank",
+                                             "hypervolume"),
                     default="result",
-                    help="result = mean best-so-far; rank = mean final rank")
+                    help="result = mean best-so-far; rank = mean final "
+                         "rank; hypervolume = mean dominated hypervolume "
+                         "(multi-objective tasks, e.g. zdt1)")
     bm.add_argument("--json", dest="as_json", action="store_true")
 
     srv = sub.add_parser(
@@ -1130,7 +1134,7 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
 def _cmd_benchmark(args, cfg) -> int:
     """Run one study (task × assessment) across the requested algorithms."""
     from metaopt_tpu.benchmark import (
-        AverageRank, AverageResult, Benchmark, task_registry,
+        AverageRank, AverageResult, Benchmark, Hypervolume, task_registry,
     )
 
     try:
@@ -1139,12 +1143,19 @@ def _cmd_benchmark(args, cfg) -> int:
         print(f"unknown task {args.task!r}; have: "
               f"{', '.join(sorted(task_registry))}", file=sys.stderr)
         return 2
-    assess = (AverageRank if args.assessment == "rank"
-              else AverageResult)(args.repetitions)
+    assess = {"rank": AverageRank, "hypervolume": Hypervolume}.get(
+        args.assessment, AverageResult)(args.repetitions)
+    task = task_cls(args.max_trials)
+    if isinstance(assess, Hypervolume):
+        try:  # detectable BEFORE any trial runs — don't waste a study
+            assess.resolve_reference(task)
+        except ValueError as err:
+            print(err, file=sys.stderr)
+            return 2
     bench = Benchmark(
         "cli",
         algorithms=list(args.algos),
-        targets=[{"assess": [assess], "task": [task_cls(args.max_trials)]}],
+        targets=[{"assess": [assess], "task": [task]}],
     )
     bench.process()
     (report,) = bench.analysis()
@@ -1153,16 +1164,27 @@ def _cmd_benchmark(args, cfg) -> int:
         return 0
     print(f"task: {report['task']}  assessment: {report['assessment']}  "
           f"repetitions: {report['repetitions']}")
+    def _num(v):  # an algorithm with zero completed trials prints n/a
+        return f"{v:.6g}" if v is not None else "n/a"
+
     if "final_best" in report:
         width = max(len(a) for a in args.algos)
-        for algo in sorted(report["final_best"],
-                           key=lambda a: report["final_best"][a]):
-            print(f"  {algo:<{width}}  final best = "
-                  f"{report['final_best'][algo]:.6g}")
+        finals = report["final_best"]
+        for algo in sorted(finals,
+                           key=lambda a: (finals[a] is None,
+                                          finals[a] or 0.0)):
+            print(f"  {algo:<{width}}  final best = {_num(finals[algo])}")
     if "ranks" in report:
         width = max(len(a) for a in args.algos)
         for algo in sorted(report["ranks"], key=lambda a: report["ranks"][a]):
             print(f"  {algo:<{width}}  mean rank = {report['ranks'][algo]:.2f}")
+    if "final_hypervolume" in report:
+        width = max(len(a) for a in args.algos)
+        finals = report["final_hypervolume"]
+        for algo in sorted(finals, key=lambda a: (finals[a] is None,
+                                                  -(finals[a] or 0.0))):
+            print(f"  {algo:<{width}}  final hypervolume = "
+                  f"{_num(finals[algo])}")
     print(f"winner: {report['winner']}")
     return 0
 

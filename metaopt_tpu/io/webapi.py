@@ -74,12 +74,22 @@ def _experiment_detail(ledger: LedgerBackend, name: str) -> Optional[Dict[str, A
     return {**doc, "stats": {"by_status": s["by_status"], "best": s["best"]}}
 
 
+def completed_in_order(ledger: LedgerBackend, name: str):
+    """Completed trials sorted by completion time — THE progress order.
+
+    Single source for every progress series (regret, lcurves,
+    hypervolume-so-far): if the ordering semantics ever change, every
+    surface moves together.
+    """
+    done = list(ledger.fetch(name, "completed"))
+    done.sort(key=lambda t: t.end_time or t.submit_time or 0.0)
+    return done
+
+
 def regret_series(ledger: LedgerBackend, name: str) -> List[Dict[str, Any]]:
     """Best-so-far objective per completed trial (shared with `mtpu plot`)."""
-    done = [t for t in ledger.fetch(name, "completed")
+    done = [t for t in completed_in_order(ledger, name)
             if t.objective is not None]
-
-    done.sort(key=lambda t: t.end_time or t.submit_time or 0.0)
     out, best = [], float("inf")
     for i, t in enumerate(done):
         best = min(best, t.objective)

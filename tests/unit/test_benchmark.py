@@ -7,9 +7,12 @@ from metaopt_tpu.benchmark import (
     AverageResult,
     Benchmark,
     Branin,
+    Hypervolume,
     Rastrigin,
     RosenBrock,
     Sphere,
+    ZDT1,
+    hypervolume_2d,
 )
 
 
@@ -98,3 +101,52 @@ class TestBenchmark:
             [{"assess": [AverageRank(1)], "task": [Branin()]}],
         )
         json.dumps(bench.configuration)
+
+
+class TestHypervolume:
+    def test_hypervolume_2d_hand_cases(self):
+        ref = [1.0, 1.0]
+        assert hypervolume_2d([[0.0, 0.0]], ref) == pytest.approx(1.0)
+        # two trade-off points: union of boxes = 0.4 + 0.4 - 0.25
+        assert hypervolume_2d(
+            [[0.2, 0.5], [0.5, 0.2]], ref) == pytest.approx(0.55)
+        # dominated and out-of-box points contribute nothing
+        assert hypervolume_2d(
+            [[0.2, 0.5], [0.3, 0.6], [2.0, 0.1]], ref
+        ) == pytest.approx(hypervolume_2d([[0.2, 0.5]], ref))
+        assert hypervolume_2d([], ref) == 0.0
+
+    def test_zdt1_reports_two_objectives(self):
+        task = ZDT1(max_trials=5)
+        out = task({"x0": 0.25, "x1": 0.0})
+        assert [r["type"] for r in out] == ["objective", "objective"]
+        # on the Pareto set (x1 = 0): f2 = 1 - sqrt(f1)
+        assert out[0]["value"] == pytest.approx(0.25)
+        assert out[1]["value"] == pytest.approx(1.0 - 0.25 ** 0.5)
+        assert task.reference_point == [1.0, 10.0]
+
+    def test_hypervolume_study_runs_motpe_vs_random(self):
+        bench = Benchmark(
+            "hv",
+            algorithms=["random",
+                        {"motpe": {"n_initial_points": 6, "gamma": 0.3}}],
+            targets=[{
+                "assess": [Hypervolume(repetitions=1)],
+                "task": [ZDT1(max_trials=12)],
+            }],
+        )
+        bench.process()
+        (study,) = bench.analysis()
+        assert study["assessment"] == "hypervolume"
+        for curve in study["curves"].values():
+            assert len(curve) == 12
+            assert curve == sorted(curve)  # HV-so-far is monotone UP
+            assert curve[-1] > 0
+        assert study["winner"] in ("random", "motpe")
+
+    def test_hypervolume_needs_a_reference_point(self):
+        from metaopt_tpu.ledger import MemoryLedger
+
+        hv = Hypervolume(repetitions=1)
+        with pytest.raises(ValueError, match="reference_point"):
+            hv.series(MemoryLedger(), "x", task=Sphere())
