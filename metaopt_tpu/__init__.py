@@ -38,6 +38,8 @@ _LAZY = {
     "Fidelity": ("metaopt_tpu.space", "Fidelity"),
     "Trial": ("metaopt_tpu.ledger.trial", "Trial"),
     "report_results": ("metaopt_tpu.client", "report_results"),
+    "build_experiment": ("metaopt_tpu.client.api", "build_experiment"),
+    "ExperimentClient": ("metaopt_tpu.client.api", "ExperimentClient"),
 }
 
 __all__ = [*_LAZY, "__version__"]

@@ -241,19 +241,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _make_ledger_from_spec(spec: Optional[str], cfg: Dict[str, Any]):
+    from metaopt_tpu.ledger.backends import ledger_from_spec
+
     if spec is None:
         lcfg = dict(cfg.get("ledger") or {"type": "file"})
         if lcfg.get("type") == "file" and not lcfg.get("path"):
             lcfg["path"] = os.path.expanduser("~/.metaopt_tpu/ledger")
         return make_ledger(lcfg)
-    if spec == "memory":
-        return make_ledger({"type": "memory"})
-    if spec.startswith("coord://"):
-        host, _, port = spec[len("coord://"):].partition(":")
-        return make_ledger({"type": "coord", "host": host, "port": int(port or 0)})
-    if spec.startswith("native:"):
-        return make_ledger({"type": "native", "path": spec[len("native:"):]})
-    return make_ledger({"type": "file", "path": spec})
+    return ledger_from_spec(spec)
 
 
 def _strip_remainder(cmd: List[str]) -> List[str]:

@@ -172,6 +172,22 @@ class profiled:
             jax.profiler.stop_trace()
 
 
+#: the library-first flow (ref: the lineage's client API —
+#: build_experiment(...).workon(fn) / suggest() / observe()). Lazy (PEP
+#: 562): every trial subprocess imports this package for report_results,
+#: and must not pay the ledger/algo import chain.
+_LAZY_API = ("build_experiment", "ExperimentClient", "WaitingForTrials",
+             "CompletedExperiment")
+
+
+def __getattr__(name):
+    if name in _LAZY_API:
+        from metaopt_tpu.client import api
+
+        return getattr(api, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "report_results",
     "report_objective",
@@ -185,4 +201,5 @@ __all__ = [
     "PROFILE_DIR_ENV",
     "CKPT_ROOT_ENV",
     "ReportError",
+    *_LAZY_API,
 ]

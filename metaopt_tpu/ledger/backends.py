@@ -430,6 +430,26 @@ class FileLedger(LedgerBackend):
             return out
 
 
+def ledger_from_spec(spec: str) -> LedgerBackend:
+    """Build a backend from the user-facing spec string.
+
+    ``"memory"`` | a directory path (file backend) | ``"native:<dir>"`` |
+    ``"coord://host:port"`` — the same grammar the CLI's ``--ledger``
+    accepts, shared here so the Python API (client.build_experiment)
+    and the CLI can never diverge.
+    """
+    if spec == "memory":
+        return make_ledger({"type": "memory"})
+    if spec.startswith("coord://"):
+        host, _, port = spec[len("coord://"):].partition(":")
+        return make_ledger(
+            {"type": "coord", "host": host, "port": int(port or 0)}
+        )
+    if spec.startswith("native:"):
+        return make_ledger({"type": "native", "path": spec[len("native:"):]})
+    return make_ledger({"type": "file", "path": spec})
+
+
 def make_ledger(config: Dict[str, Any]) -> LedgerBackend:
     """Build a backend from ``{"type": ..., **kwargs}`` (see ledger_registry)."""
     cfg = dict(config)

@@ -1,0 +1,139 @@
+"""The Python-API flow: build_experiment(...).workon(fn) / suggest-observe.
+
+ref: the lineage's client API role (build_experiment → ExperimentClient
+with workon and the manual suggest/observe loop) — both UIs must drive
+the same coordination machinery the CLI does.
+"""
+
+import pytest
+
+from metaopt_tpu import build_experiment
+from metaopt_tpu.client import CompletedExperiment, WaitingForTrials
+from metaopt_tpu.ledger.backends import make_ledger
+
+
+class TestWorkonFlow:
+    def test_scalar_objective_to_done(self):
+        exp = build_experiment(
+            "api-demo", space={"x": "uniform(-5, 5)"},
+            algorithm={"random": {"seed": 1}}, max_trials=8,
+        )
+        exp.workon(lambda p: (p["x"] - 1.0) ** 2)
+        assert exp.is_done
+        assert exp.stats["by_status"]["completed"] == 8
+        assert exp.best.objective == pytest.approx(
+            min((t.params["x"] - 1.0) ** 2
+                for t in exp.fetch_trials("completed"))
+        )
+
+    def test_resume_adopts_stored_config(self, tmp_path):
+        ledger = str(tmp_path / "ledger")
+        exp = build_experiment(
+            "resume-me", space={"x": "uniform(0, 1)"},
+            algorithm={"random": {"seed": 2}}, max_trials=4, ledger=ledger,
+        )
+        exp.workon(lambda p: p["x"])
+        # re-open WITHOUT a space: must adopt the stored one (hunt parity)
+        again = build_experiment("resume-me", ledger=ledger)
+        assert again.is_done
+        assert sorted(again.space.keys()) == ["x"]
+
+    def test_multiobjective_results_and_front(self):
+        exp = build_experiment(
+            "api-mo", space={"x": "uniform(0, 1)"},
+            algorithm={"motpe": {"seed": 3, "n_initial_points": 4}},
+            max_trials=10,
+        )
+        exp.workon(lambda p: [
+            {"name": "f1", "type": "objective", "value": p["x"]},
+            {"name": "f2", "type": "objective", "value": (1 - p["x"]) ** 2},
+        ])
+        front = exp.pareto_front()
+        assert front
+        for params, objs in front:
+            assert len(objs) == 2 and set(params) == {"x"}
+
+
+class TestManualLoop:
+    def test_suggest_observe_cycle(self):
+        exp = build_experiment(
+            "manual", space={"x": "uniform(-1, 1)"},
+            algorithm={"random": {"seed": 5}}, max_trials=3,
+        )
+        seen = []
+        while True:
+            try:
+                trial = exp.suggest()
+            except CompletedExperiment:
+                break
+            seen.append(trial.id)
+            exp.observe(trial, abs(trial.params["x"]))
+        assert len(seen) == len(set(seen)) == 3
+        assert exp.is_done and exp.best is not None
+
+    def test_suggest_raises_waiting_when_all_in_flight(self):
+        # pool_size 1: the first suggest takes the only producible trial;
+        # a second (different client, same ledger) has nothing to reserve
+        ledger = make_ledger({"type": "memory"})
+        a = build_experiment(
+            "flight", space={"x": "uniform(0, 1)"},
+            algorithm={"random": {"seed": 1}}, max_trials=1, ledger=ledger,
+        )
+        b = build_experiment("flight", ledger=ledger, worker_id="api-1")
+        t = a.suggest()
+        with pytest.raises(WaitingForTrials):
+            b.suggest()
+        a.observe(t, 0.5)
+        with pytest.raises(CompletedExperiment):
+            b.suggest()
+
+    def test_release_requeues_by_default(self):
+        exp = build_experiment(
+            "release", space={"x": "uniform(0, 1)"},
+            algorithm={"random": {"seed": 7}}, max_trials=1,
+        )
+        t = exp.suggest()
+        exp.release(t)
+        # the SAME point comes back (re-queued, not regenerated): a
+        # deterministic algorithm must not lose it forever
+        t2 = exp.suggest()
+        assert t2.id == t.id and t2.params == t.params
+        exp.observe(t2, 0.1)
+        assert exp.is_done
+
+    def test_release_can_abandon(self):
+        exp = build_experiment(
+            "abandon", space={"x": "uniform(0, 1)"},
+            algorithm={"random": {"seed": 8}}, max_trials=1,
+        )
+        t = exp.suggest()
+        exp.release(t, status="interrupted")
+        assert exp.fetch_trials("interrupted")
+        assert not exp.is_done
+
+    def test_observe_rejects_objectiveless_results(self):
+        exp = build_experiment(
+            "noobj", space={"x": "uniform(0, 1)"},
+            algorithm={"random": {"seed": 9}}, max_trials=2,
+        )
+        t = exp.suggest()
+        with pytest.raises(ValueError, match="objective"):
+            exp.observe(t, [{"name": "acc", "type": "statistic",
+                             "value": 0.9}])
+        # the trial is still reserved; a proper observe works
+        exp.observe(t, 1.0)
+        assert exp.stats["by_status"]["completed"] == 1
+
+    def test_observe_raises_on_lost_reservation(self):
+        exp = build_experiment(
+            "lost", space={"x": "uniform(0, 1)"},
+            algorithm={"random": {"seed": 10}}, max_trials=1,
+        )
+        t = exp.suggest()
+        # a pacemaker elsewhere re-frees the lapsed reservation
+        t_stale = exp.fetch_trials("reserved")[0]
+        t_stale.heartbeat -= 10_000
+        exp.experiment.ledger.update_trial(t_stale)
+        exp.experiment.ledger.release_stale(exp.name, 60.0)
+        with pytest.raises(RuntimeError, match="NOT recorded"):
+            exp.observe(t, 0.3)
