@@ -114,10 +114,18 @@ def build_parser() -> argparse.ArgumentParser:
     ins.add_argument("--params", required=True,
                      help='JSON dict of param values, e.g. \'{"x": 1.5}\'')
 
-    res = sub.add_parser("resume", help="flip suspended trials back to new")
+    res = sub.add_parser("resume",
+                         help="flip parked trials back to new (reservable)")
     common(res)
     res.add_argument("--trial-id", default=None,
-                     help="resume one trial (default: all suspended)")
+                     help="resume one trial (default: all matching)")
+    res.add_argument("--statuses", default="suspended",
+                     help="comma list of statuses to revive (from "
+                          "suspended/interrupted/broken; default "
+                          "suspended). Interrupted trials' params stay "
+                          "registered, so deterministic algorithms can't "
+                          "re-suggest them — reviving is the only retry "
+                          "path.")
 
     ls = sub.add_parser("list", help="list experiments on the ledger")
     ls.add_argument("--config", help="framework config YAML")
@@ -524,18 +532,43 @@ def _cmd_insert(args, cfg: Dict[str, Any]) -> int:
 
 
 def _cmd_resume(args, cfg: Dict[str, Any]) -> int:
-    """Unpark suspended trials: suspended → new, reservable again."""
+    """Unpark trials: suspended/interrupted/broken → new, reservable again.
+
+    An interrupted or broken trial's params remain registered (dedup), so
+    no algorithm can ever re-suggest that point — reviving the trial is
+    the retry path (``--statuses interrupted,broken``).
+    """
+    revivable = ("suspended", "interrupted", "broken")
+    statuses = [s.strip() for s in args.statuses.split(",") if s.strip()]
+    if not statuses:
+        raise SystemExit(
+            f"--statuses is empty; name statuses from {revivable}"
+        )
+    bad = [s for s in statuses if s not in revivable]
+    if bad:
+        raise SystemExit(
+            f"--statuses must name statuses from {revivable}, got {bad}"
+        )
     exp, _ = _experiment_from_args(args, cfg, need_cmd=False)
-    suspended = exp.fetch_trials("suspended")
+    parked = [t for s in statuses for t in exp.fetch_trials(s)]
     if args.trial_id:
-        suspended = [t for t in suspended if t.id.startswith(args.trial_id)]
-        if not suspended:
-            raise SystemExit(f"no suspended trial matching {args.trial_id!r}")
+        parked = [t for t in parked if t.id.startswith(args.trial_id)]
+        if not parked:
+            raise SystemExit(
+                f"no {'/'.join(statuses)} trial matching {args.trial_id!r}"
+            )
     resumed = 0
-    for t in suspended:
+    for t in parked:
+        was = t.status
         t.transition("new")
         t.worker = None
-        if exp.ledger.update_trial(t, expected_status="suspended"):
+        # clear the terminal residue interrupted/broken left behind — a
+        # revived 'new' trial must not look like it already finished
+        t.start_time = None
+        t.end_time = None
+        t.heartbeat = None
+        t.exit_code = None
+        if exp.ledger.update_trial(t, expected_status=was):
             resumed += 1
     print(f"resumed {resumed} trial(s)")
     return 0

@@ -157,8 +157,41 @@ def test_resume_flips_suspended_trials(tmp_path, capsys):
     assert "resumed 2 trial(s)" in capsys.readouterr().out
     assert all(ledger.get("susp", i).status == "new" for i in ids)
 
+
+def test_resume_revives_interrupted_and_broken(tmp_path, capsys):
+    led = str(tmp_path / "iledger")
+    ledger = _make_ledger_from_spec(led, {})
+    space = build_space({"x": "uniform(-5, 5)"})
+    exp = Experiment("intr", ledger, space=space, max_trials=9).configure()
+    ids = {}
+    for x, status in ((1.0, "interrupted"), (2.0, "broken")):
+        t = exp.make_trial({"x": x})
+        exp.register_trials([t])
+        got = exp.reserve_trial("w")
+        got.transition(status)
+        assert ledger.update_trial(got, expected_status="reserved")
+        ids[status] = got.id
+
+    # default statuses (suspended) touches neither
+    assert cli_main(["resume", "-n", "intr", "--ledger", led]) == 0
+    assert "resumed 0 trial(s)" in capsys.readouterr().out
+
+    # explicit revive: both become reservable again (the only retry path —
+    # their params stay registered so no algorithm can re-suggest them)
+    assert cli_main(["resume", "-n", "intr", "--ledger", led,
+                     "--statuses", "interrupted,broken"]) == 0
+    assert "resumed 2 trial(s)" in capsys.readouterr().out
+    assert all(ledger.get("intr", i).status == "new" for i in ids.values())
+    # terminal residue is cleared: a revived trial must not look finished
+    revived = ledger.get("intr", ids["broken"])
+    assert revived.end_time is None and revived.exit_code is None
+
+    with pytest.raises(SystemExit, match="completed"):
+        cli_main(["resume", "-n", "intr", "--ledger", led,
+                  "--statuses", "completed"])
+
     with pytest.raises(SystemExit, match="no suspended trial"):
-        cli_main(["resume", "-n", "susp", "--ledger", led,
+        cli_main(["resume", "-n", "intr", "--ledger", led,
                   "--trial-id", "zzzz"])
 
 
