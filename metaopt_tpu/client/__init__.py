@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Mapping, Optional
 
 RESULTS_PATH_ENV = "METAOPT_TPU_RESULTS_PATH"
 TRIAL_INFO_ENV = "METAOPT_TPU_TRIAL_INFO"
+STOP_PATH_ENV = "METAOPT_TPU_STOP_PATH"
 
 IS_ORCHESTRATED = RESULTS_PATH_ENV in os.environ
 
@@ -69,6 +70,21 @@ def report_results(data: List[Mapping[str, Any]]) -> None:
 def report_objective(value: float, name: str = "objective") -> None:
     """Shorthand for the common single-scalar case."""
     report_results([{"name": name, "type": "objective", "value": float(value)}])
+
+
+def stop_requested() -> bool:
+    """Has the executor asked this trial to stop (judge pruned it)?
+
+    The cooperative half of early stopping: the executor touches a stop
+    sentinel, waits a grace period, then SIGTERMs. A script that polls
+    this (or passes it as ``should_stop`` to
+    :func:`metaopt_tpu.parallel.control.run_signaled` — which agrees the
+    verdict over the trial's mesh so a gang-scheduled trial exits
+    coherently) can report its partial results and exit cleanly instead
+    of dying mid-step. Always False outside an orchestrated trial.
+    """
+    path = os.environ.get(STOP_PATH_ENV)
+    return bool(path) and os.path.exists(path)
 
 
 def report_partial(objective: float, step: int) -> None:
@@ -192,6 +208,8 @@ __all__ = [
     "report_results",
     "report_objective",
     "report_partial",
+    "stop_requested",
+    "STOP_PATH_ENV",
     "get_trial_info",
     "checkpoint_paths",
     "profiled",

@@ -25,9 +25,19 @@ import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
-from metaopt_tpu.client import RESULTS_PATH_ENV, TRIAL_INFO_ENV
+from metaopt_tpu.client import (
+    RESULTS_PATH_ENV,
+    STOP_PATH_ENV,
+    TRIAL_INFO_ENV,
+)
 from metaopt_tpu.executor.base import ExecutionResult, Executor, HeartbeatFn, JudgeFn
 from metaopt_tpu.executor.faults import faults
+
+
+def _stop_path(results_path: str) -> str:
+    """The stop-sentinel path — ONE derivation for the env injection and
+    the prune-time touch, so the two can never drift apart."""
+    return results_path + ".stop"
 from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.space.builder import CommandTemplate
 
@@ -41,6 +51,7 @@ class SubprocessExecutor(Executor):
         poll_interval_s: float = 0.2,
         heartbeat_every_s: float = 5.0,
         timeout_s: Optional[float] = None,
+        prune_grace_s: float = 1.0,
         extra_env: Optional[Dict[str, str]] = None,
         profile_dir: Optional[str] = None,
         ckpt_root: Optional[str] = None,
@@ -52,6 +63,7 @@ class SubprocessExecutor(Executor):
         self.poll_interval_s = poll_interval_s
         self.heartbeat_every_s = heartbeat_every_s
         self.timeout_s = timeout_s
+        self.prune_grace_s = prune_grace_s
         self.extra_env = dict(extra_env or {})
         if profile_dir:  # opt-in per-trial jax.profiler traces (client.profiled)
             self.extra_env["METAOPT_TPU_PROFILE_DIR"] = profile_dir
@@ -105,6 +117,7 @@ class SubprocessExecutor(Executor):
         if pkg_root not in parts:
             env["PYTHONPATH"] = os.pathsep.join([pkg_root] + [p for p in parts if p])
         env[RESULTS_PATH_ENV] = results_path
+        env[STOP_PATH_ENV] = _stop_path(results_path)
         env[TRIAL_INFO_ENV] = json.dumps(
             {
                 "id": trial.id,
@@ -193,7 +206,34 @@ class SubprocessExecutor(Executor):
                             decision = judge(trial, partial)
                             if decision and decision.get("stop"):
                                 pruned = True
-                                self._kill(proc)
+                                # cooperative first: touch the stop
+                                # sentinel (client.stop_requested) so a
+                                # gang-scheduled multi-process trial can
+                                # agree-to-stop on its mesh and exit
+                                # cleanly; SIGTERM only after the grace —
+                                # a kill mid-collective strands the rest
+                                # of the gang
+                                self._touch(_stop_path(results_path))
+                                deadline = time.time() + self.prune_grace_s
+                                while (proc.poll() is None
+                                       and time.time() < deadline):
+                                    # the lease must not lapse during a
+                                    # long grace: keep beating (and honor
+                                    # the overall timeout) while waiting
+                                    now2 = time.time()
+                                    if (self.timeout_s
+                                            and now2 - started
+                                            > self.timeout_s):
+                                        break
+                                    if (heartbeat
+                                            and now2 - last_beat
+                                            >= self.heartbeat_every_s):
+                                        last_beat = now2
+                                        if not heartbeat():
+                                            break
+                                    time.sleep(self.poll_interval_s)
+                                if proc.poll() is None:
+                                    self._kill(proc)
                                 proc.wait()
                                 break
                     time.sleep(self.poll_interval_s)
@@ -224,6 +264,14 @@ class SubprocessExecutor(Executor):
                 )
             note = "pruned by judge" if pruned else ""
             return ExecutionResult("completed", results=results, exit_code=rc, note=note)
+
+    @staticmethod
+    def _touch(path: str) -> None:
+        try:
+            with open(path, "w"):
+                pass
+        except OSError:
+            pass  # sentinel is best-effort; the SIGTERM fallback remains
 
     @staticmethod
     def _kill(proc: subprocess.Popen) -> None:

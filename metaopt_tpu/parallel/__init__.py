@@ -23,6 +23,10 @@ from metaopt_tpu.parallel.sharding import (
     shard_batch,
     shard_params,
 )
+from metaopt_tpu.parallel.control import (
+    pod_agree,
+    run_signaled,
+)
 
 __all__ = [
     "trial_devices",
@@ -32,4 +36,6 @@ __all__ = [
     "replicate",
     "batch_spec",
     "shard_params",
+    "pod_agree",
+    "run_signaled",
 ]

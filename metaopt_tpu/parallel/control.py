@@ -1,0 +1,104 @@
+"""Pod-global control signals as mesh collectives (the ICI path).
+
+ref: BASELINE.json north star — "ASHA/Hyperband rung bookkeeping
+pod-global via ICI broadcast of promotions / early-stop signals". The
+coordinator's control plane (``set_signal`` → heartbeat piggyback) is a
+DCN channel: only a trial's host-0 process polls it. But a
+gang-scheduled trial executing collectives over a multi-chip mesh cannot
+act on that signal unilaterally — if one process leaves the step loop
+while the others enter the next ``psum``, the pod hangs. This module
+closes that loop the TPU way: the stop decision is agreed ON THE MESH
+(one tiny all-reduce riding ICI within a slice, DCN across slices), so
+every participating process leaves the loop at the same step.
+
+Usage inside a distributed trial::
+
+    from metaopt_tpu.parallel.control import run_signaled
+
+    def should_stop():           # host 0 polls the coordinator; other
+        ...                      # hosts just return False
+
+    carry, steps, stopped = run_signaled(
+        step, carry, mesh=mesh, should_stop=should_stop,
+        max_steps=1000, check_every=50,
+    )
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@functools.lru_cache(maxsize=8)
+def _pod_reducer(mesh: Mesh):
+    """(input sharding, jitted all-reduce) for a mesh — built ONCE.
+
+    pod_agree sits on the trial's step loop (every ``check_every``
+    steps); rebuilding the Mesh/shardings/jit wrapper per call would pay
+    a fresh trace + dispatch-cache miss each time instead of the
+    intended tiny all-reduce.
+    """
+    devs = mesh.devices.reshape(-1)
+    flat = Mesh(devs, ("_pod",))
+    sharding = NamedSharding(flat, P("_pod"))
+    reduce = jax.jit(jnp.max, out_shardings=NamedSharding(flat, P()))
+    return sharding, reduce
+
+
+def pod_agree(mesh: Mesh, local_flag: bool) -> bool:
+    """Global OR of a per-process flag over every device in ``mesh``.
+
+    One 8-byte-per-device all-reduce: each process contributes its flag
+    on its addressable devices; the jitted ``max`` reduces across the
+    whole mesh (XLA inserts the cross-host collective) and the result is
+    replicated, so every process reads the identical verdict. Safe to
+    call under multi-controller SPMD — all processes MUST call it
+    together (it is itself a collective program).
+    """
+    sharding, reduce = _pod_reducer(mesh)
+    val = np.int32(1 if local_flag else 0)
+    arr = jax.make_array_from_callback(
+        (mesh.devices.size,), sharding,
+        lambda idx: np.full((1,), val, np.int32),
+    )
+    out = reduce(arr)
+    # fully replicated: the local shard holds the global verdict
+    return bool(np.asarray(out.addressable_shards[0].data))
+
+
+def run_signaled(
+    step_fn: Callable[[Any], Any],
+    carry: Any,
+    *,
+    mesh: Mesh,
+    should_stop: Callable[[], bool],
+    max_steps: int,
+    check_every: int = 50,
+) -> Tuple[Any, int, bool]:
+    """Drive ``carry = step_fn(carry)`` with pod-coherent early stop.
+
+    Every ``check_every`` steps, each process contributes
+    ``should_stop()`` (host 0 typically polls the coordinator's signal
+    channel; other hosts return False) and the pod takes the global OR
+    via :func:`pod_agree` — so either every process keeps stepping or
+    every process stops, at the same step count. Returns
+    ``(carry, steps_run, stopped_early)``.
+    """
+    if check_every < 1:
+        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    steps = 0
+    while steps < max_steps:
+        chunk = min(check_every, max_steps - steps)
+        for _ in range(chunk):
+            carry = step_fn(carry)
+        steps += chunk
+        if pod_agree(mesh, bool(should_stop())):
+            return carry, steps, True
+    return carry, steps, False

@@ -1,0 +1,34 @@
+"""User script that honors the cooperative stop sentinel.
+
+Streams partials; when the executor's judge prunes it
+(client.stop_requested() flips True), it reports FINAL results with a
+clean-exit marker instead of dying to the SIGTERM fallback.
+"""
+
+import argparse
+import time
+
+from metaopt_tpu.client import report_partial, report_results, stop_requested
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-x", type=float, required=True)
+    p.add_argument("--steps", type=int, default=60)
+    args = p.parse_args()
+    obj = (args.x - 1.0) ** 2
+    for step in range(args.steps):
+        report_partial(obj + (args.steps - step - 1) * 0.1, step)
+        if stop_requested():
+            report_results([
+                {"name": "objective", "type": "objective", "value": obj},
+                {"name": "clean_exit_at", "type": "statistic",
+                 "value": step},
+            ])
+            return
+        time.sleep(0.05)
+    report_results([{"name": "objective", "type": "objective", "value": obj}])
+
+
+if __name__ == "__main__":
+    main()

@@ -262,6 +262,37 @@ class TestJudgePruning:
             assert any(r.name == "pruned_at_step" for r in t.results)
 
 
+    def test_pruned_trial_can_exit_cleanly_via_stop_sentinel(self, tmp_path):
+        """The cooperative half of pruning: the executor touches the stop
+        sentinel and grants a grace period, so a script polling
+        client.stop_requested() reports its own final results (clean exit)
+        instead of dying to the SIGTERM fallback."""
+        from tests.dumbalgo import DumbAlgo  # noqa: F401 (registers plugin)
+        from metaopt_tpu.executor import SubprocessExecutor
+        from metaopt_tpu.space import SpaceBuilder
+        from metaopt_tpu.worker import workon
+
+        coop = os.path.join(HERE, "black_box_cooperative.py")
+        argv = [coop, "-x~uniform(-2, 2)", "--steps=60"]
+        space, template = SpaceBuilder().build(argv)
+        exp = Experiment(
+            "coop", make_ledger({"type": "file", "path": str(tmp_path)}),
+            space=space, max_trials=1,
+            algorithm={"dumbalgo": {"judge_stop_below": 1e9}},
+        ).configure()
+        execu = SubprocessExecutor(
+            template, interpreter=[sys.executable], poll_interval_s=0.05,
+            prune_grace_s=10.0,
+        )
+        stats = workon(exp, execu, "w0")
+        assert stats.completed == 1 and stats.pruned == 1
+        (t,) = exp.fetch_completed_trials()
+        # the script's OWN final report landed — NOT the SIGTERM path's
+        # rung-measurement fallback (which would carry pruned_at_step)
+        assert any(r.name == "clean_exit_at" for r in t.results)
+        assert not any(r.name == "pruned_at_step" for r in t.results)
+
+
 class TestChaos:
     def test_hunt_completes_under_injected_faults(self, tmp_path):
         """Chaos tier (SURVEY.md §5 fault injection): spawn failures and

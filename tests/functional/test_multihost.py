@@ -275,3 +275,52 @@ def test_ulysses_across_two_processes(tmp_path):
     outs = _run_pair(ULYSSES_CHILD)
     for i, out in enumerate(outs):
         assert f"proc {i} OK" in out, out
+
+
+CONTROL_CHILD = r"""
+import os, sys
+proc, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=proc)
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from metaopt_tpu.parallel.control import run_signaled
+
+devs = jax.devices()
+assert len(devs) == 8
+mesh = Mesh(np.array(devs).reshape(2, 4), ("pp", "dp"))
+
+# ONLY process 0 ever sees the signal (the coordinator-polling host);
+# process 1's local flag is always False. The mesh collective must make
+# BOTH processes stop at the same chunk boundary — a unilateral exit
+# would hang the other process in pod_agree's own all-reduce.
+state = {"n": 0}
+def step(c):
+    state["n"] += 1
+    return c + 1
+
+def should_stop():
+    return proc == 0 and state["n"] >= 6
+
+carry, steps, stopped = run_signaled(
+    step, 0, mesh=mesh, should_stop=should_stop,
+    max_steps=100, check_every=4,
+)
+assert stopped and steps == 8, (steps, stopped)
+print(f"proc {proc} OK: stopped together at step {steps}", flush=True)
+"""
+
+
+def test_pod_coherent_early_stop_across_two_processes(tmp_path):
+    """The ICI-style control plane: a stop signal visible to one host is
+    agreed over the mesh so the whole gang leaves the step loop at the
+    same step (north star: early-stop broadcast as a mesh collective)."""
+    outs = _run_pair(CONTROL_CHILD)
+    for i, out in enumerate(outs):
+        assert f"proc {i} OK: stopped together at step 8" in out, out
