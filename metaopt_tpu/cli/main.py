@@ -72,6 +72,16 @@ def build_parser() -> argparse.ArgumentParser:
                            "another; the parent's completed trials are "
                            "adapted into the (possibly changed) space and "
                            "observed before suggesting")
+    hunt.add_argument("--on-conflict", dest="on_conflict", default=None,
+                      choices=["adopt", "fail", "branch"],
+                      help="what to do when the command's ~priors (or "
+                           "--algo) differ from the stored experiment: "
+                           "adopt = warn and defer to the stored config "
+                           "(the reference's joiner semantics, default); "
+                           "fail = stop; branch = EVC auto-resolution — "
+                           "create NAME-vN branched from the latest "
+                           "version (rerunning the same changed command "
+                           "joins the branch it already created)")
     hunt.add_argument("--branch-default", dest="branch_default",
                       action="append", metavar="NAME=VALUE",
                       help="value backfilled into parent trials for a "
@@ -102,6 +112,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     init = sub.add_parser("init-only", help="create the experiment and exit")
     common(init)
+    init.add_argument("--on-conflict", dest="on_conflict", default=None,
+                      choices=["adopt", "fail", "branch"])
     init.add_argument("--branch-from", dest="branch_from", default=None)
     init.add_argument("--branch-rename", dest="branch_rename",
                       action="append", metavar="OLD=NEW")
@@ -165,7 +177,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     db = sub.add_parser("db", help="ledger backend utilities")
     db.add_argument("action", choices=["test", "rm", "compact", "dump",
-                                       "load"],
+                                       "load", "set", "release"],
                     help="test: drive the full backend contract (create, "
                          "dup-detect, reserve CAS, heartbeat, stale "
                          "release) against the configured ledger; "
@@ -174,7 +186,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "log to its live state (reclaims heartbeat spam); "
                          "dump: archive experiments + trials to portable "
                          "JSON; load: restore an archive into the "
-                         "configured ledger")
+                         "configured ledger; "
+                         "set: edit experiment fields (max_trials=N, "
+                         "pool_size=N) or, with --trial, force a trial's "
+                         "status; release: free reserved trials back to "
+                         "'new' immediately (instead of waiting for the "
+                         "stale-heartbeat sweep)")
     db.add_argument("-n", "--name",
                     help="experiment to delete (rm) / archive (dump; "
                          "default all)")
@@ -189,6 +206,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "ignore (skip existing), overwrite (replace doc + "
                          "trials), bump (load as NAME-vN with version+1 and "
                          "parent set, the EVC-style sibling)")
+    db.add_argument("--trial", dest="trial_id", default=None,
+                    help="set/release: act on one trial (id prefix ok)")
+    db.add_argument("assignments", nargs="*", metavar="KEY=VALUE",
+                    help="set: fields to change")
     db.add_argument("--json", action="store_true", dest="as_json",
                     help="test: emit the check report as JSON")
     db.add_argument("--config", help="framework config YAML")
@@ -263,6 +284,84 @@ def _strip_remainder(cmd: List[str]) -> List[str]:
     return cmd[1:] if cmd[:1] == ["--"] else cmd
 
 
+def _family_versions(ledger, name: str):
+    """The stored version family of an experiment, plus the free slot.
+
+    Returns ``(members, next_name, next_version)``: ``members`` is the
+    ``name`` document followed by the ``name-vN`` siblings that EVC
+    auto-resolution (and ``db load --resolve bump``) created, ordered by
+    version suffix; ``next_name``/``next_version`` is one past the
+    HIGHEST occupied (or squatted) slot — a gap left by ``db rm`` is
+    never reused, so surviving later versions keep their lineage intact.
+    A ``name-vN`` experiment whose lineage does NOT chain back to the
+    family (a user-created name that happens to match the pattern, an
+    orphan whose parent version was deleted, or a child created BEFORE
+    its claimed parent — i.e. the head was deleted and the name reused)
+    is skipped — it blocks its slot but is neither joined nor branched
+    from.
+    """
+    import re
+
+    from metaopt_tpu.ledger.evc import branch_parent
+
+    def created_at(d) -> Optional[str]:
+        # UTC isoformat stamped at configure(); lexicographic order is
+        # chronological order
+        return (d.get("metadata") or {}).get("datetime")
+
+    doc = ledger.load_experiment(name)
+    if doc is None:
+        return [], name, 1
+    out = [(name, doc)]
+    family_created = {name: created_at(doc)}
+    pat = re.compile(re.escape(name) + r"-v(\d+)$")
+    sibs = sorted(
+        (int(m.group(1)), n)
+        for n in ledger.list_experiments()
+        for m in [pat.match(n)] if m
+    )
+    top = int(doc.get("version", 1))
+    for v, n in sibs:
+        top = max(top, v)
+        cdoc = ledger.load_experiment(n)
+        if cdoc is None:
+            continue
+        parent = branch_parent(cdoc)
+        if parent not in family_created:
+            continue
+        c_at, p_at = created_at(cdoc), family_created[parent]
+        if c_at is not None and p_at is not None and c_at < p_at:
+            # the child predates the experiment its parent NAME now
+            # denotes: a stale orphan of a deleted-and-recreated head
+            continue
+        out.append((n, cdoc))
+        family_created[n] = c_at
+    return out, f"{name}-v{top + 1}", top + 1
+
+
+def _conflict_summary(stored: Dict[str, str], new: Dict[str, str],
+                      stored_algo: List[str],
+                      requested_algo: Optional[List[str]]) -> str:
+    parts = []
+    changed = sorted(k for k in stored.keys() & new.keys()
+                     if stored[k] != new[k])
+    added = sorted(new.keys() - stored.keys())
+    removed = sorted(stored.keys() - new.keys())
+    for k in changed:
+        parts.append(f"{k}: {stored[k]} -> {new[k]}")
+    for k in added:
+        parts.append(f"+{k}~{new[k]}")
+    for k in removed:
+        parts.append(f"-{k}~{stored[k]}")
+    if requested_algo is not None and stored_algo \
+            and requested_algo != stored_algo:
+        parts.append(
+            f"algorithm: {'/'.join(stored_algo)} -> "
+            f"{'/'.join(requested_algo)}"
+        )
+    return "; ".join(parts)
+
+
 def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
     user_argv = _strip_remainder(getattr(args, "cmd", []) or [])
     name = args.name or cfg.get("name")
@@ -284,6 +383,74 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
         metadata["warm_start"] = warm
     version = 1
     branch = getattr(args, "branch_from", None) or cfg.get("branch_from")
+    on_conflict = (getattr(args, "on_conflict", None)
+                   or cfg.get("on_conflict") or "adopt")
+    auto_branch_version: Optional[int] = None
+    if not branch:
+        from metaopt_tpu.io.resolve_config import DEFAULTS
+
+        requested_algo: Optional[List[str]] = None
+        if getattr(args, "algo", None):
+            requested_algo = [args.algo]
+        elif cfg.get("algorithm") not in (None, DEFAULTS["algorithm"]):
+            requested_algo = sorted(cfg["algorithm"].keys())
+
+        def _fits(mdoc) -> bool:
+            if space is not None \
+                    and (mdoc.get("space") or {}) != space.configuration:
+                return False
+            if requested_algo is not None and mdoc.get("algorithm") \
+                    and sorted(mdoc["algorithm"].keys()) != requested_algo:
+                return False
+            return True
+
+        if space is not None or requested_algo is not None:
+            family, free_name, free_version = _family_versions(ledger, name)
+        else:
+            family, free_name, free_version = [], name, 1
+        match = next(((mn, md) for mn, md in family if _fits(md)), None)
+        if family and match is None:
+            # diff against the experiment configure() would actually join
+            # (the named one), not the newest family version
+            base_doc = family[0][1]
+            stored_space = base_doc.get("space") or {}
+            diff = _conflict_summary(
+                stored_space,
+                space.configuration if space is not None else stored_space,
+                sorted((base_doc.get("algorithm") or {}).keys()),
+                requested_algo,
+            )
+            if on_conflict == "fail":
+                raise SystemExit(
+                    f"experiment {name!r} exists with a different "
+                    f"configuration ({diff}); rerun with --on-conflict "
+                    f"branch to version it, or adopt to defer to the "
+                    f"stored config"
+                )
+            if on_conflict == "branch":
+                # parent = newest FAMILY member; child name = the first
+                # free -vN slot (never an unrelated name-squatter)
+                branch = family[-1][0]
+                name = free_name
+                auto_branch_version = free_version
+                log.warning(
+                    "EVC: configuration changed (%s); branching %r from %r",
+                    diff, name, branch,
+                )
+            else:
+                log.warning(
+                    "experiment %r already exists; your command's "
+                    "configuration differs (%s) and the STORED config "
+                    "wins — pass --on-conflict branch to version the "
+                    "change, or fail to stop instead",
+                    name, diff,
+                )
+        elif match is not None and match[0] != name:
+            log.warning(
+                "EVC: this configuration matches version %d (%r); "
+                "joining it", match[1].get("version", 1), match[0],
+            )
+            name = match[0]
     if branch:
         if branch == name:
             raise SystemExit("--branch-from: the child needs its own name")
@@ -295,8 +462,9 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
             raise SystemExit(f"--branch-from: no such experiment {branch!r}")
         existing_child = ledger.load_experiment(name)
         if existing_child is not None:
-            stored = (existing_child.get("metadata") or {}).get("branch") or {}
-            if stored.get("parent") != branch:
+            from metaopt_tpu.ledger.evc import branch_parent
+
+            if branch_parent(existing_child) != branch:
                 # configure() adopts stored config, which would silently drop
                 # the requested branch — refuse instead
                 raise SystemExit(
@@ -335,6 +503,10 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
             "adapter": adapter.describe(),
         }
         version = parent_doc.get("version", 1) + 1
+        if auto_branch_version is not None:
+            # the -vN suffix of an auto-branch child must agree with its
+            # document even when a name-squatter forced a later slot
+            version = max(version, auto_branch_version)
     from metaopt_tpu.io.resolve_config import DEFAULTS
 
     algorithm = cfg.get("algorithm")
@@ -560,14 +732,7 @@ def _cmd_resume(args, cfg: Dict[str, Any]) -> int:
     resumed = 0
     for t in parked:
         was = t.status
-        t.transition("new")
-        t.worker = None
-        # clear the terminal residue interrupted/broken left behind — a
-        # revived 'new' trial must not look like it already finished
-        t.start_time = None
-        t.end_time = None
-        t.heartbeat = None
-        t.exit_code = None
+        t.reset_to_new()
         if exp.ledger.update_trial(t, expected_status=was):
             resumed += 1
     print(f"resumed {resumed} trial(s)")
@@ -584,13 +749,35 @@ def _cmd_list(args, cfg: Dict[str, Any]) -> int:
             for name in sorted(ledger.list_experiments())]
     if args.as_json:
         print(json.dumps(rows, indent=2))
-    else:
-        if not rows:
-            print("no experiments")
-        for r in rows:
-            flag = " [done]" if r["done"] else ""
-            print(f"{r['name']}: {r['completed']}/{r['max_trials']} completed "
-                  f"({r['trials']} trials, {r['algorithm'] or '?'}){flag}")
+        return 0
+    if not rows:
+        print("no experiments")
+        return 0
+    # EVC families render as a tree: children indent under the version
+    # they branched from (ref: the lineage's version-aware `orion list`)
+    by_name = {r["name"]: r for r in rows}
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    roots: List[Dict[str, Any]] = []
+    for r in rows:
+        p = r.get("parent")
+        if p and p in by_name:
+            children.setdefault(p, []).append(r)
+        else:
+            roots.append(r)
+
+    def emit(r: Dict[str, Any], depth: int) -> None:
+        flag = " [done]" if r["done"] else ""
+        pre = "  " * depth + ("└─ " if depth else "")
+        ver = f" (v{r['version']})" if r.get("version", 1) != 1 else ""
+        print(f"{pre}{r['name']}{ver}: {r['completed']}/{r['max_trials']} "
+              f"completed ({r['trials']} trials, "
+              f"{r['algorithm'] or '?'}){flag}")
+        for c in sorted(children.get(r["name"], []),
+                        key=lambda c: (c.get("version", 1), c["name"])):
+            emit(c, depth + 1)
+
+    for r in roots:
+        emit(r, 0)
     return 0
 
 
@@ -969,6 +1156,123 @@ def _db_load(args, ledger) -> int:
     return 0
 
 
+#: experiment-document fields `db set` may edit, with their coercions.
+#: ref: the lineage's `orion db set` (post-v0 admin surface) — mutating
+#: anything else (space, algorithm) would invalidate registered trials;
+#: that path is EVC branching, not an in-place edit.
+_SETTABLE_EXP_FIELDS = {"max_trials": int, "pool_size": int}
+
+
+def _resolve_trial_prefix(trials, prefix: str, what: str):
+    """Exactly one trial whose id starts with ``prefix``, or SystemExit."""
+    matches = [t for t in trials if t.id.startswith(prefix)]
+    if not matches:
+        raise SystemExit(f"no {what} matching {prefix!r}")
+    if len(matches) > 1:
+        raise SystemExit(
+            f"{prefix!r} is ambiguous ({len(matches)} trials); "
+            f"use a longer prefix"
+        )
+    return matches[0]
+
+
+def _db_set(args, ledger) -> int:
+    """Edit experiment fields, or force a trial's status (admin override)."""
+    from metaopt_tpu.ledger.trial import STATUSES
+
+    if not args.name:
+        raise SystemExit("db set needs an experiment name (-n/--name)")
+    if ledger.load_experiment(args.name) is None:
+        raise SystemExit(f"no such experiment: {args.name}")
+    assignments: Dict[str, str] = {}
+    for kv in args.assignments or []:
+        key, sep, raw = kv.partition("=")
+        if not sep:
+            raise SystemExit(f"db set wants KEY=VALUE, got {kv!r}")
+        assignments[key] = raw
+    if not assignments:
+        raise SystemExit("db set: nothing to change (pass KEY=VALUE)")
+
+    if args.trial_id:
+        if list(assignments) != ["status"]:
+            raise SystemExit(
+                "db set --trial supports exactly one assignment: status=…"
+            )
+        status = assignments["status"]
+        if status not in STATUSES:
+            raise SystemExit(
+                f"unknown status {status!r}; one of {sorted(STATUSES)}"
+            )
+        t = _resolve_trial_prefix(ledger.fetch(args.name), args.trial_id,
+                                  "trial")
+        was = t.status
+        # admin override: bypass lifecycle legality but keep the
+        # bookkeeping consistent with where the trial lands
+        if status == "new":
+            t.reset_to_new()
+        else:
+            t.status = status
+            now = time.time()
+            if status == "reserved":
+                # a reservation without a heartbeat would be invisible to
+                # the stale sweep (release_stale skips heartbeat=None) —
+                # stamp it like transition() would
+                t.start_time = t.start_time or now
+                t.heartbeat = now
+            elif status in ("completed", "broken", "interrupted") \
+                    and t.end_time is None:
+                t.end_time = now
+        if not ledger.update_trial(t, expected_status=was):
+            raise SystemExit(
+                f"trial {t.id} changed state concurrently; re-run"
+            )
+        print(f"trial {t.id}: {was} -> {status}")
+        return 0
+
+    patch: Dict[str, Any] = {}
+    for key, raw in assignments.items():
+        coerce = _SETTABLE_EXP_FIELDS.get(key)
+        if coerce is None:
+            raise SystemExit(
+                f"db set: field {key!r} is not editable (only "
+                f"{sorted(_SETTABLE_EXP_FIELDS)}; space/algorithm changes "
+                f"are EVC branches — see hunt --on-conflict branch)"
+            )
+        try:
+            patch[key] = coerce(raw)
+        except ValueError:
+            raise SystemExit(f"db set: {key} wants {coerce.__name__}, "
+                             f"got {raw!r}")
+    ledger.update_experiment(args.name, patch)
+    print(f"{args.name}: set " +
+          ", ".join(f"{k}={v}" for k, v in patch.items()))
+    return 0
+
+
+def _db_release(args, ledger) -> int:
+    """Force reserved trials back to 'new' without waiting for staleness.
+
+    The CAS (`expected_status="reserved"` on the write, and the executor's
+    `expected_worker` guard on the old owner's next write) keeps a racing
+    live worker safe: whichever side loses the CAS abandons its claim.
+    """
+    if not args.name:
+        raise SystemExit("db release needs an experiment name (-n/--name)")
+    if ledger.load_experiment(args.name) is None:
+        raise SystemExit(f"no such experiment: {args.name}")
+    reserved = ledger.fetch(args.name, status="reserved")
+    if args.trial_id:
+        reserved = [_resolve_trial_prefix(reserved, args.trial_id,
+                                          "reserved trial")]
+    released = 0
+    for t in reserved:
+        t.reset_to_new()
+        if ledger.update_trial(t, expected_status="reserved"):
+            released += 1
+    print(f"released {released} trial(s)")
+    return 0
+
+
 def _cmd_db(args, cfg: Dict[str, Any]) -> int:
     """ref: the lineage's `db test` — validate a live backend end-to-end.
 
@@ -983,11 +1287,22 @@ def _cmd_db(args, cfg: Dict[str, Any]) -> int:
         DuplicateTrialError,
     )
 
+    if args.action != "set" and getattr(args, "assignments", None):
+        # a stray positional silently ignored is how `db release -n exp
+        # TRIALID` (forgot --trial) would release EVERY reservation
+        raise SystemExit(
+            f"db {args.action} takes no KEY=VALUE arguments, got "
+            f"{args.assignments!r}"
+        )
     ledger = _make_ledger_from_spec(args.ledger, cfg)
     if args.action == "dump":
         return _db_dump(args, ledger)
     if args.action == "load":
         return _db_load(args, ledger)
+    if args.action == "set":
+        return _db_set(args, ledger)
+    if args.action == "release":
+        return _db_release(args, ledger)
     if args.action == "compact":
         if not hasattr(ledger, "compact"):
             raise SystemExit(

@@ -35,6 +35,19 @@ class BranchConflictError(ValueError):
     """The child space cannot absorb the parent's trials as configured."""
 
 
+def branch_parent(doc: Dict[str, Any]) -> Optional[str]:
+    """The experiment a document was branched from, if any.
+
+    Two storage shapes exist: ``metadata.branch.parent`` (hunt
+    ``--branch-from`` / ``--on-conflict branch``) and top-level
+    ``parent`` (``db load --resolve bump``). Every surface that reasons
+    about lineage (the CLI family walk, ``mtpu list`` trees, the web
+    API) must read them through this one helper.
+    """
+    return ((doc.get("metadata") or {}).get("branch") or {}) \
+        .get("parent") or doc.get("parent")
+
+
 class TrialAdapter:
     """Maps one experiment's trials into a (possibly different) space."""
 

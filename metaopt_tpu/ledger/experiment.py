@@ -143,9 +143,14 @@ class Experiment:
     # -- completion -------------------------------------------------------
     @property
     def is_done(self) -> bool:
+        # refresh the budget from the ledger so a live `mtpu db set -n X
+        # max_trials=N` takes effect in running workers' workon loops —
+        # the doc round-trip is already paid for algo_done below
+        doc = self.ledger.load_experiment(self.name)
+        if doc and doc.get("max_trials") is not None:
+            self.max_trials = doc["max_trials"]
         if self.count("completed") >= self.max_trials:
             return True
-        doc = self.ledger.load_experiment(self.name)
         if not (doc and doc.get("algo_done")):
             return False
         # the algorithm has nothing more to SUGGEST, but already-registered

@@ -261,3 +261,255 @@ class TestRenameAdapter:
         child2 = build_space({"b": "uniform(0, 1)"})
         with _pytest.raises(BranchConflictError, match="already exists"):
             TrialAdapter(parent, child2, renames={"a": "b"})
+
+
+class TestOnConflict:
+    """hunt/init-only vs a stored experiment whose config differs.
+
+    ref: the lineage's EVC conflict resolution (post-v0): a changed prior
+    or algorithm on an existing experiment is detected at configure time;
+    --on-conflict picks adopt (v0 joiner semantics, default) / fail /
+    branch (auto-version as NAME-vN).
+    """
+
+    def _init(self, led, name, prior, extra=()):
+        return cli_main([
+            "init-only", "-n", name, "--ledger", led, *extra,
+            "--", "x.py", f"-x~{prior}",
+        ])
+
+    def test_default_adopts_stored_config_with_warning(self, tmp_path, caplog):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        import logging
+
+        with caplog.at_level(logging.WARNING, "metaopt_tpu.cli.main"):
+            self._init(led, "exp", "uniform(0, 9)")
+        assert any("STORED config wins" in r.message for r in caplog.records)
+        from metaopt_tpu.cli.main import _make_ledger_from_spec
+
+        ledger = _make_ledger_from_spec(led, {})
+        assert ledger.load_experiment("exp")["space"] == {
+            "x": "uniform(0, 1)"
+        }
+        assert ledger.load_experiment("exp-v2") is None
+
+    def test_fail_stops_and_names_the_diff(self, tmp_path):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        with pytest.raises(SystemExit, match="uniform"):
+            self._init(led, "exp", "uniform(0, 9)",
+                       extra=("--on-conflict", "fail"))
+
+    def test_branch_auto_versions(self, tmp_path):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        self._init(led, "exp", "uniform(0, 9)",
+                   extra=("--on-conflict", "branch"))
+        from metaopt_tpu.cli.main import _make_ledger_from_spec
+
+        ledger = _make_ledger_from_spec(led, {})
+        child = ledger.load_experiment("exp-v2")
+        assert child is not None
+        assert child["version"] == 2
+        assert child["metadata"]["branch"]["parent"] == "exp"
+        assert child["space"] == {"x": "uniform(0, 9)"}
+
+    def test_branch_rejoin_is_idempotent(self, tmp_path):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        for _ in range(2):  # same changed command twice: one branch only
+            self._init(led, "exp", "uniform(0, 9)",
+                       extra=("--on-conflict", "branch"))
+        from metaopt_tpu.cli.main import _make_ledger_from_spec
+
+        ledger = _make_ledger_from_spec(led, {})
+        assert ledger.load_experiment("exp-v2") is not None
+        assert ledger.load_experiment("exp-v2-v3") is None
+        assert ledger.load_experiment("exp-v3") is None
+
+    def test_original_command_rejoins_original_version(self, tmp_path):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        self._init(led, "exp", "uniform(0, 9)",
+                   extra=("--on-conflict", "branch"))
+        # the ORIGINAL command still matches version 1: no new branch
+        self._init(led, "exp", "uniform(0, 1)",
+                   extra=("--on-conflict", "branch"))
+        from metaopt_tpu.cli.main import _make_ledger_from_spec
+
+        ledger = _make_ledger_from_spec(led, {})
+        assert ledger.load_experiment("exp-v3") is None
+
+    def test_second_change_branches_from_latest(self, tmp_path):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        self._init(led, "exp", "uniform(0, 9)",
+                   extra=("--on-conflict", "branch"))
+        self._init(led, "exp", "uniform(0, 99)",
+                   extra=("--on-conflict", "branch"))
+        from metaopt_tpu.cli.main import _make_ledger_from_spec
+
+        ledger = _make_ledger_from_spec(led, {})
+        v3 = ledger.load_experiment("exp-v3")
+        assert v3 is not None
+        assert v3["version"] == 3
+        assert v3["metadata"]["branch"]["parent"] == "exp-v2"
+
+    def test_algorithm_change_is_a_conflict(self, tmp_path):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        with pytest.raises(SystemExit, match="algorithm"):
+            self._init(led, "exp", "uniform(0, 1)",
+                       extra=("--algo", "tpe", "--on-conflict", "fail"))
+        # same algorithm name is NOT a conflict
+        rc = self._init(led, "exp", "uniform(0, 1)",
+                        extra=("--algo", "random", "--on-conflict", "fail"))
+        assert rc == 0
+
+    def test_unrelated_vN_sibling_does_not_hang_the_family_walk(self, tmp_path):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        # an INDEPENDENT experiment whose name matches the -vN pattern but
+        # whose document says version 1 — the walk must advance past it
+        self._init(led, "exp-v2", "uniform(0, 3)")
+        with pytest.raises(SystemExit, match="different"):
+            self._init(led, "exp", "uniform(0, 7)",
+                       extra=("--on-conflict", "fail"))
+
+    def test_adopt_warning_names_the_joined_experiment(self, tmp_path, caplog):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        self._init(led, "exp", "uniform(0, 9)",
+                   extra=("--on-conflict", "branch"))  # -> exp-v2
+        import logging
+
+        with caplog.at_level(logging.WARNING, "metaopt_tpu.cli.main"):
+            self._init(led, "exp", "uniform(0, 5)")  # adopt (default)
+        warn = next(r.message for r in caplog.records
+                    if "STORED config wins" in r.message)
+        # the warning must describe the experiment actually joined ('exp',
+        # prior uniform(0, 1)) — not the newest family version
+        assert "'exp'" in warn and "uniform(0, 1)" in warn \
+            and "uniform(0, 9)" not in warn
+
+    def test_joiner_algo_conflict_detected_without_cmd(self, tmp_path):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        # a joiner (no trailing cmd) that requests a different algorithm
+        with pytest.raises(SystemExit, match="algorithm"):
+            cli_main(["hunt", "-n", "exp", "--ledger", led,
+                      "--algo", "tpe", "--on-conflict", "fail"])
+
+    def test_branch_skips_unrelated_name_squatter(self, tmp_path):
+        led = str(tmp_path / "l")
+        self._init(led, "exp", "uniform(0, 1)")
+        # an INDEPENDENT experiment squatting the -v2 slot
+        self._init(led, "exp-v2", "uniform(0, 3)")
+        self._init(led, "exp", "uniform(0, 7)",
+                   extra=("--on-conflict", "branch"))
+        from metaopt_tpu.cli.main import _make_ledger_from_spec
+
+        ledger = _make_ledger_from_spec(led, {})
+        child = ledger.load_experiment("exp-v3")
+        assert child is not None, "child must land in the free -v3 slot"
+        # parent is the real family head, NOT the squatter
+        assert child["metadata"]["branch"]["parent"] == "exp"
+        assert child["version"] == 3  # suffix and document agree
+        # the squatter is untouched
+        assert ledger.load_experiment("exp-v2")["space"] == {
+            "x": "uniform(0, 3)"
+        }
+
+
+class TestListTree:
+    def test_list_renders_version_families_as_a_tree(self, tmp_path, capsys):
+        led = str(tmp_path / "l")
+        cli_main(["init-only", "-n", "exp", "--ledger", led,
+                  "--", "x.py", "-x~uniform(0, 1)"])
+        cli_main(["init-only", "-n", "exp", "--ledger", led,
+                  "--on-conflict", "branch",
+                  "--", "x.py", "-x~uniform(0, 9)"])
+        cli_main(["init-only", "-n", "solo", "--ledger", led,
+                  "--", "x.py", "-y~uniform(0, 1)"])
+        capsys.readouterr()
+        assert cli_main(["list", "--ledger", led]) == 0
+        out = capsys.readouterr().out.splitlines()
+        assert out[0].startswith("exp:")
+        assert out[1].strip().startswith("└─ exp-v2 (v2):")
+        assert any(line.startswith("solo:") for line in out)
+        # JSON stays flat but carries the lineage fields
+        assert cli_main(["list", "--ledger", led, "--json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        byname = {r["name"]: r for r in rows}
+        assert byname["exp-v2"]["parent"] == "exp"
+        assert byname["exp-v2"]["version"] == 2
+        assert byname["exp"]["parent"] is None
+
+
+def test_db_rm_gap_is_never_reused(tmp_path):
+    led = str(tmp_path / "l")
+
+    def init(prior, *extra):
+        cli_main(["init-only", "-n", "exp", "--ledger", led, *extra,
+                  "--", "x.py", f"-x~{prior}"])
+
+    init("uniform(0, 1)")
+    init("uniform(0, 9)", "--on-conflict", "branch")   # exp-v2
+    init("uniform(0, 99)", "--on-conflict", "branch")  # exp-v3
+    cli_main(["db", "rm", "-n", "exp-v2", "--ledger", led, "--force"])
+    # a new conflict must land PAST the highest slot, not in the gap
+    # (reusing -v2 would corrupt exp-v3's stored lineage)
+    init("uniform(0, 999)", "--on-conflict", "branch")
+    from metaopt_tpu.cli.main import _make_ledger_from_spec
+
+    ledger = _make_ledger_from_spec(led, {})
+    assert ledger.load_experiment("exp-v2") is None
+    v4 = ledger.load_experiment("exp-v4")
+    assert v4 is not None and v4["version"] == 4
+    # exp-v3's parent (deleted exp-v2) is gone: it is an orphan, so
+    # the new branch chains from the family head instead
+    assert v4["metadata"]["branch"]["parent"] == "exp"
+
+
+def test_branch_from_accepts_a_bumped_archive_child(tmp_path, capsys):
+    """`db load --resolve bump` children store top-level `parent`; a later
+
+    `hunt --branch-from` onto that name must recognize the lineage.
+    """
+    led = str(tmp_path / "l")
+    cli_main(["init-only", "-n", "exp", "--ledger", led,
+              "--", "x.py", "-x~uniform(0, 1)"])
+    arch = str(tmp_path / "a.json")
+    cli_main(["db", "dump", "-n", "exp", "--ledger", led, "-o", arch])
+    cli_main(["db", "load", "--file", arch, "--ledger", led,
+              "--resolve", "bump"])  # -> exp-v2, parent='exp'
+    capsys.readouterr()
+    # re-running the branch command onto the bumped child: recognized,
+    # not refused as "already exists and was not branched from"
+    rc = cli_main(["init-only", "-n", "exp-v2", "--ledger", led,
+                   "--branch-from", "exp",
+                   "--", "x.py", "-x~uniform(0, 1)"])
+    assert rc == 0
+
+
+def test_recreated_head_does_not_adopt_stale_orphans(tmp_path, caplog):
+    """Delete the family head, recreate the name with a different config:
+
+    the old head's children are stale orphans — a command matching one of
+    THEIR configs must conflict with the new head, not silently join the
+    orphan.
+    """
+    led = str(tmp_path / "l")
+
+    def init(prior, *extra):
+        return cli_main(["init-only", "-n", "exp", "--ledger", led,
+                         *extra, "--", "x.py", f"-x~{prior}"])
+
+    init("uniform(0, 1)")
+    init("uniform(0, 9)", "--on-conflict", "branch")  # exp-v2
+    cli_main(["db", "rm", "-n", "exp", "--ledger", led, "--force"])
+    init("uniform(0, 5)")  # recreate the head, different space
+    # a command matching the STALE orphan's space: must fail, not join it
+    with pytest.raises(SystemExit, match="different"):
+        init("uniform(0, 9)", "--on-conflict", "fail")
