@@ -52,9 +52,12 @@ def _experiment_summary(ledger: LedgerBackend, name: str) -> Dict[str, Any]:
     doc = ledger.load_experiment(name) or {}
     completed = ledger.count(name, "completed")
     max_trials = doc.get("max_trials")
+    from metaopt_tpu.ledger.evc import branch_parent
+
     return {
         "name": name,
         "version": doc.get("version", 1),
+        "parent": branch_parent(doc),
         "algorithm": next(iter(doc.get("algorithm", {})), None),
         "trials": ledger.count(name),
         "completed": completed,

@@ -1,0 +1,213 @@
+"""`mtpu db set` / `db release`: the admin edit surface.
+
+ref: the lineage's post-v0 `orion db set` / `orion db release` admin
+commands — in-place edits of experiment bookkeeping fields, forced trial
+status overrides, and immediate reservation release (instead of waiting
+for the stale-heartbeat sweep).
+"""
+
+import pytest
+
+from metaopt_tpu.cli.main import main as cli_main
+from metaopt_tpu.ledger.backends import make_ledger
+from metaopt_tpu.ledger.trial import Trial
+
+
+def seed(ledger, name="exp", n=3):
+    ledger.create_experiment({
+        "name": name, "space": {"x": "uniform(0, 1)"},
+        "algorithm": {"random": {"seed": 1}}, "max_trials": n, "version": 1,
+    })
+    trials = []
+    for i in range(n):
+        t = Trial(params={"x": i / 10}, experiment=name)
+        ledger.register(t)
+        trials.append(t)
+    return trials
+
+
+class TestDbSet:
+    def test_edits_max_trials_and_pool_size(self, tmp_path, capsys):
+        led = str(tmp_path / "l")
+        seed(make_ledger({"type": "file", "path": led}))
+        rc = cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                       "max_trials=50", "pool_size=4"])
+        assert rc == 0
+        doc = make_ledger({"type": "file", "path": led}).load_experiment("exp")
+        assert doc["max_trials"] == 50
+        assert doc["pool_size"] == 4
+
+    def test_non_whitelisted_field_refused(self, tmp_path):
+        led = str(tmp_path / "l")
+        seed(make_ledger({"type": "file", "path": led}))
+        with pytest.raises(SystemExit, match="not editable"):
+            cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                      "space=whatever"])
+        with pytest.raises(SystemExit, match="int"):
+            cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                      "max_trials=lots"])
+
+    def test_trial_status_override(self, tmp_path, capsys):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        seed(ledger)
+        t = ledger.reserve("exp", "w0")
+        rc = cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                       "--trial", t.id[:8], "status=broken"])
+        assert rc == 0
+        got = ledger.get("exp", t.id)
+        assert got.status == "broken"
+        assert got.end_time is not None
+        # back to new clears the residue (same doctrine as `resume`)
+        rc = cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                       "--trial", t.id[:8], "status=new"])
+        assert rc == 0
+        got = ledger.get("exp", t.id)
+        assert got.status == "new" and got.worker is None
+        assert got.end_time is None and got.heartbeat is None
+        # and it is reservable again
+        again = ledger.reserve("exp", "w1")
+        assert again is not None
+
+    def test_trial_override_rejects_unknown_status_and_extra_keys(
+            self, tmp_path):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        trials = seed(ledger)
+        with pytest.raises(SystemExit, match="unknown status"):
+            cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                      "--trial", trials[0].id, "status=zombie"])
+        with pytest.raises(SystemExit, match="exactly one"):
+            cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                      "--trial", trials[0].id, "status=new",
+                      "max_trials=9"])
+
+    def test_ambiguous_prefix_refused(self, tmp_path):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        seed(ledger, n=0)
+        for i, tid in enumerate(("aaaa1000", "aaaa2000")):
+            t = Trial(params={"x": i / 10}, experiment="exp")
+            t.id = tid
+            ledger.register(t)
+        with pytest.raises(SystemExit, match="ambiguous"):
+            cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                      "--trial", "aaaa", "status=new"])
+
+
+class TestDbRelease:
+    def test_releases_reserved_back_to_new(self, tmp_path, capsys):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        seed(ledger)
+        a = ledger.reserve("exp", "w0")
+        b = ledger.reserve("exp", "w1")
+        assert a is not None and b is not None
+        rc = cli_main(["db", "release", "-n", "exp", "--ledger", led])
+        assert rc == 0
+        assert "released 2 trial(s)" in capsys.readouterr().out
+        assert ledger.count("exp", "reserved") == 0
+        assert ledger.count("exp", "new") == 3
+
+    def test_release_single_trial_by_prefix(self, tmp_path, capsys):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        seed(ledger)
+        a = ledger.reserve("exp", "w0")
+        b = ledger.reserve("exp", "w1")
+        rc = cli_main(["db", "release", "-n", "exp", "--ledger", led,
+                       "--trial", a.id[:8]])
+        assert rc == 0
+        assert "released 1 trial(s)" in capsys.readouterr().out
+        assert ledger.get("exp", a.id).status == "new"
+        assert ledger.get("exp", b.id).status == "reserved"
+
+    def test_missing_experiment_fails(self, tmp_path):
+        with pytest.raises(SystemExit, match="no such experiment"):
+            cli_main(["db", "release", "-n", "ghost",
+                      "--ledger", str(tmp_path / "l")])
+
+
+class TestDbArgsHygiene:
+    def test_stray_positionals_rejected_outside_set(self, tmp_path):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        seed(ledger)
+        ledger.reserve("exp", "w0")
+        # forgot --trial: the id must NOT be silently ignored (it would
+        # release every reservation)
+        with pytest.raises(SystemExit, match="takes no KEY=VALUE"):
+            cli_main(["db", "release", "-n", "exp", "--ledger", led,
+                      "deadbeef"])
+        assert ledger.count("exp", "reserved") == 1
+
+    def test_release_trial_prefix_guards(self, tmp_path):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        seed(ledger, n=0)
+        for i, tid in enumerate(("aaaa1000", "aaaa2000")):
+            t = Trial(params={"x": i / 10}, experiment="exp")
+            t.id = tid
+            ledger.register(t)
+        ledger.reserve("exp", "w0")
+        ledger.reserve("exp", "w1")
+        with pytest.raises(SystemExit, match="ambiguous"):
+            cli_main(["db", "release", "-n", "exp", "--ledger", led,
+                      "--trial", "aaaa"])
+        with pytest.raises(SystemExit, match="no reserved trial"):
+            cli_main(["db", "release", "-n", "exp", "--ledger", led,
+                      "--trial", "ffff"])
+        assert ledger.count("exp", "reserved") == 2
+
+
+class TestResetResidue:
+    def test_revived_trial_drops_stale_results(self, tmp_path):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        seed(ledger)
+        t = ledger.reserve("exp", "w0")
+        t.attach_results(
+            [{"name": "o", "type": "objective", "value": 5.0}]
+        )
+        t.transition("completed")
+        assert ledger.update_trial(t, expected_status="reserved")
+        cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                  "--trial", t.id[:8], "status=new"])
+        got = ledger.get("exp", t.id)
+        # a stale first-objective would shadow the re-run's measurement
+        assert got.results == [] and got.objective is None
+
+    def test_forced_reserved_is_stale_releasable(self, tmp_path):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        trials = seed(ledger)
+        cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                  "--trial", trials[0].id, "status=reserved"])
+        got = ledger.get("exp", trials[0].id)
+        assert got.heartbeat is not None  # visible to the stale sweep
+        got.heartbeat -= 9999.0
+        assert ledger.update_trial(got, expected_status="reserved")
+        freed = ledger.release_stale("exp", timeout_s=60.0)
+        assert [t.id for t in freed] == [trials[0].id]
+
+    def test_live_max_trials_edit_reaches_is_done(self, tmp_path):
+        from metaopt_tpu.ledger.experiment import Experiment
+
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        seed(ledger)  # max_trials=3
+        exp = Experiment("exp", ledger).configure()
+        for _ in range(3):
+            t = ledger.reserve("exp", "w0")
+            t.attach_results(
+                [{"name": "o", "type": "objective", "value": 1.0}]
+            )
+            t.transition("completed")
+            ledger.update_trial(t, expected_status="reserved")
+        assert exp.is_done
+        # raise the budget from ANOTHER process (the admin CLI): the live
+        # handle must see it on its next is_done poll
+        cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                  "max_trials=5"])
+        assert not exp.is_done
+        assert exp.max_trials == 5
