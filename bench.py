@@ -357,11 +357,36 @@ def bench_flash_pallas() -> dict:
                       repeats=20)
     chunked_ms = time_fn(lambda: jax.block_until_ready(chunked_fn(q, k, v)),
                          repeats=20)
+
+    # the two-pass Pallas BACKWARD (dKV + dQ kernels): compile via Mosaic,
+    # check grads against the chunked blockwise backward, time the full
+    # grad step
+    def grads(impl):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, impl=impl, interpret=False) ** 2)
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    gp_fn, gc_fn = grads("pallas"), grads("chunked")
+    gp = jax.block_until_ready(gp_fn(q, k, v))
+    gc = jax.block_until_ready(gc_fn(q, k, v))
+    gerr = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                              - b.astype(jnp.float32))))
+        for a, b in zip(gp, gc)
+    )
+    gstep_ms = time_fn(lambda: jax.block_until_ready(gp_fn(q, k, v)),
+                       repeats=20)
+    gchunked_ms = time_fn(lambda: jax.block_until_ready(gc_fn(q, k, v)),
+                          repeats=20)
     return {"flash_pallas": {
         "status": "ok",
         "step_ms": round(step_ms, 3),
         "chunked_step_ms": round(chunked_ms, 3),
         "max_abs_err_vs_chunked": err,
+        "bwd_step_ms": round(gstep_ms, 3),
+        "bwd_chunked_step_ms": round(gchunked_ms, 3),
+        "bwd_max_abs_err_vs_chunked": gerr,
         "shape": [b, s, h, d],
     }}
 

@@ -1243,6 +1243,10 @@ def _db_set(args, ledger) -> int:
         except ValueError:
             raise SystemExit(f"db set: {key} wants {coerce.__name__}, "
                              f"got {raw!r}")
+        if patch[key] < 1:
+            # a stored 0 stalls the producer (pool) or instantly finishes
+            # the experiment (max_trials) with no error anywhere
+            raise SystemExit(f"db set: {key} must be >= 1, got {patch[key]}")
     ledger.update_experiment(args.name, patch)
     print(f"{args.name}: set " +
           ", ".join(f"{k}={v}" for k, v in patch.items()))

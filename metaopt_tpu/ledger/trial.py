@@ -120,6 +120,9 @@ class Trial:
         self.heartbeat = None
         self.exit_code = None
         self.results = []
+        # stale chip assignments must not leak into the next run's env
+        # (the executor re-injects resources["env"] at launch)
+        self.resources = {}
 
     # -- results ----------------------------------------------------------
     @property

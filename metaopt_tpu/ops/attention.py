@@ -19,11 +19,15 @@ implementations share one custom-VJP wrapper:
   attention-probability dropout, reproduced bit-exactly in the backward
   from the same ``fold_in`` counter stream.
 
-Backward is always the chunked formulation (blockwise recompute from the
-saved (q, k, v, mask, lse) — the forward emits the per-row logsumexp for
-exactly this): peak memory stays O(Sq·block_k) per step, so the forward's
-HBM saving is preserved through training rather than forfeited to a
-whole-array recompute.
+Backward is blockwise recompute from the saved (q, k, v, mask, lse) — the
+forward emits the per-row logsumexp for exactly this — so peak memory
+stays O(Sq·block_k) per step and the forward's HBM saving is preserved
+through training. Two formulations: ``impl="pallas"`` (dropout-free)
+runs the two-pass Pallas kernels (``_flash_bwd_dkv_kernel`` parallel
+over K blocks + ``_flash_bwd_dq_kernel`` parallel over Q blocks — TPU
+has no cross-program atomics, so each pass owns its outputs exclusively);
+everything else uses the chunked ``lax.scan`` formulation, which also
+replays dropout bit-exactly from the same ``fold_in`` counter stream.
 
 Irregular sequence lengths are padded up to block multiples with masked
 tails (``_block_and_pad``); block sizes never exceed the requested
@@ -168,6 +172,200 @@ def _pallas_forward(q, k, v, mask, block_q, block_k, interpret):
     )(*operands)
     return (out.reshape(b, h, sq, d).transpose(0, 2, 1, 3),
             lse.reshape(b, h, sq))
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels (FlashAttention-2 style: two passes, no atomics)
+
+
+def _flash_bwd_dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref,
+                          mask_ref, dk_ref, dv_ref, *, block_q: int):
+    """One (batch·head, k-block) program: dK/dV over all Q blocks.
+
+    TPU has no cross-program atomics, so the backward splits into a dKV
+    pass (this kernel, parallel over K blocks) and a dQ pass (below,
+    parallel over Q blocks) — each output is owned by exactly one
+    program. Shapes in VMEM: q/g (1, Sq, D) full; k/v (1, Bk, D) block;
+    lse/delta (1, Sq, 1) full; mask (1, Sq, Bk) int8 block or None.
+    p is recomputed from the saved lse (p = exp(s − lse)), the same
+    normalized-probability recomputation the chunked twin uses; ds =
+    p ⊙ (dO·Vᵀ − delta) with delta = rowsum(dO ⊙ O) precomputed in XLA.
+    """
+    kb = k_ref[0].astype(jnp.float32)                      # (Bk, D)
+    vb = v_ref[0].astype(jnp.float32)
+    bk, d = kb.shape
+    sq = q_ref.shape[1]
+    n_blocks = sq // block_q
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        gb = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_b = lse_ref[0, pl.ds(i * block_q, block_q), :]  # (Bq, 1) f32
+        delta_b = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = jax.lax.dot_general(                            # (Bq, Bk)
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if mask_ref is not None:
+            mb = mask_ref[0, pl.ds(i * block_q, block_q), :]
+            s = jnp.where(mb != 0, s, _NEG_BIG)
+        # fully-masked rows carry lse = +inf from the forward → p = 0
+        p = jnp.exp(s - lse_b)
+        gp = jax.lax.dot_general(                           # dO·Vᵀ (Bq, Bk)
+            gb, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (gp - delta_b)
+        dv_new = dv + jax.lax.dot_general(                  # pᵀ·dO (Bk, D)
+            p, gb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_new = dk + jax.lax.dot_general(                  # dsᵀ·q (Bk, D)
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(0, n_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref,
+                         mask_ref, dq_ref, *, block_k: int):
+    """One (batch·head, q-block) program: dQ over all K blocks."""
+    qb = q_ref[0].astype(jnp.float32)                      # (Bq, D)
+    gb = g_ref[0].astype(jnp.float32)
+    lse_b = lse_ref[0]                                     # (Bq, 1) f32
+    delta_b = delta_ref[0]
+    bq, d = qb.shape
+    sk = k_ref.shape[1]
+    n_blocks = sk // block_k
+
+    def body(i, dq):
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if mask_ref is not None:
+            mb = mask_ref[0, :, pl.ds(i * block_k, block_k)]
+            s = jnp.where(mb != 0, s, _NEG_BIG)
+        p = jnp.exp(s - lse_b)
+        gp = jax.lax.dot_general(
+            gb, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (gp - delta_b)
+        return dq + jax.lax.dot_general(                    # ds·K (Bq, D)
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, n_blocks, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _pallas_backward(q, k, v, mask, out, lse, g, block_q, block_k, interpret):
+    """(dq, dk, dv) via the two Pallas passes. Shapes pre-padded."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    gf = g.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    of = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # delta = rowsum(dO ⊙ O): one fused elementwise+reduce, cheaper in XLA
+    # than re-deriving O inside the kernels
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)                # (B·H, Sq, 1)
+    lsef = lse.reshape(b * h, sq, 1)
+    m8 = mask.astype(jnp.int8) if mask is not None else None
+
+    full_q = [
+        pl.BlockSpec((1, sq, d), lambda bh, i: (bh, 0, 0)),      # q
+        pl.BlockSpec((1, sq, d), lambda bh, i: (bh, 0, 0)),      # g
+    ]
+    stats = [
+        pl.BlockSpec((1, sq, 1), lambda bh, i: (bh, 0, 0)),      # lse
+        pl.BlockSpec((1, sq, 1), lambda bh, i: (bh, 0, 0)),      # delta
+    ]
+
+    # pass 1: dK/dV, one program per K block
+    in_specs = full_q + [
+        pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),  # k
+        pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),  # v
+    ] + stats
+    operands = [qf, gf, kf, vf, lsef, delta]
+    if m8 is not None:
+        in_specs.append(
+            pl.BlockSpec((1, sq, block_k),
+                         lambda bh, ki, h=h: (bh // h, 0, ki))
+        )
+        operands.append(m8)
+        dkv_kernel = functools.partial(_flash_bwd_dkv_kernel,
+                                       block_q=block_q)
+    else:
+        def dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref):
+            _flash_bwd_dkv_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref,
+                                  delta_ref, None, dk_ref, dv_ref,
+                                  block_q=block_q)
+    # the dKV pass reorders q/g/k/v operands: q/g are the full arrays
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        grid=(b * h, sk // block_k),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        interpret=interpret,
+    )(*operands)
+
+    # pass 2: dQ, one program per Q block
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),  # q
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),  # g
+        pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),        # k
+        pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),        # v
+        pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),  # lse
+        pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),  # delta
+    ]
+    operands = [qf, gf, kf, vf, lsef, delta]
+    if m8 is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_q, sk),
+                         lambda bh, qi, h=h: (bh // h, qi, 0))
+        )
+        operands.append(m8)
+        dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=block_k)
+    else:
+        def dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref, delta_ref,
+                      dq_ref):
+            _flash_bwd_dq_kernel(q_ref, g_ref, k_ref, v_ref, lse_ref,
+                                 delta_ref, None, dq_ref, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // block_q),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(*operands)
+
+    unflat = lambda t, s: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)  # noqa: E731
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
 
 
 # ---------------------------------------------------------------------------
@@ -343,9 +541,16 @@ def _flash_fwd_rule(q, k, v, mask, key, dropout_rate, block_q, block_k, impl,
 def _flash_bwd_rule(dropout_rate, block_q, block_k, impl, interpret,
                     residuals, g):
     q, k, v, mask, key, out, lse = residuals
-    dq, dk, dv = _chunked_backward(
-        q, k, v, mask, key, out, lse, g, block_k, dropout_rate
-    )
+    if impl == "pallas" and dropout_rate == 0.0:
+        # the pallas forward never carries dropout (flash_attention routes
+        # dropout to chunked), so the pallas backward needs no mask replay
+        dq, dk, dv = _pallas_backward(
+            q, k, v, mask, out, lse, g, block_q, block_k, interpret
+        )
+    else:
+        dq, dk, dv = _chunked_backward(
+            q, k, v, mask, key, out, lse, g, block_k, dropout_rate
+        )
     return dq, dk, dv, None, None
 
 

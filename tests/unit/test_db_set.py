@@ -211,3 +211,24 @@ class TestResetResidue:
                   "max_trials=5"])
         assert not exp.is_done
         assert exp.max_trials == 5
+
+    def test_non_positive_values_refused(self, tmp_path):
+        led = str(tmp_path / "l")
+        seed(make_ledger({"type": "file", "path": led}))
+        for kv in ("pool_size=0", "max_trials=-5"):
+            with pytest.raises(SystemExit, match=">= 1"):
+                cli_main(["db", "set", "-n", "exp", "--ledger", led, kv])
+
+    def test_reset_clears_chip_assignments(self, tmp_path):
+        led = str(tmp_path / "l")
+        ledger = make_ledger({"type": "file", "path": led})
+        seed(ledger)
+        t = ledger.reserve("exp", "w0")
+        t.resources = {"chips": [2], "env": {"TPU_VISIBLE_CHIPS": "2"}}
+        t.transition("broken")
+        assert ledger.update_trial(t, expected_status="reserved")
+        cli_main(["db", "set", "-n", "exp", "--ledger", led,
+                  "--trial", t.id[:8], "status=new"])
+        got = ledger.get("exp", t.id)
+        # a revived trial must not replay the previous run's chip pinning
+        assert got.resources == {}
