@@ -174,6 +174,10 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--rungs", action="store_true",
                     help="rung occupancy for multi-fidelity algorithms "
                          "(replays completed trials into the algorithm)")
+    st.add_argument("--workers", action="store_true",
+                    help="per-worker liveness derived from trial "
+                         "ownership + heartbeats (who holds what, last "
+                         "seen when)")
 
     db = sub.add_parser("db", help="ledger backend utilities")
     db.add_argument("action", choices=["test", "rm", "compact", "dump",
@@ -797,6 +801,10 @@ def _cmd_status(args, cfg: Dict[str, Any]) -> int:
             algo = make_algorithm(exp.space, exp.algorithm)
             algo.observe(exp.fetch_completed_trials())
             s["rungs"] = getattr(algo, "rung_table", None)
+        if args.workers:
+            from metaopt_tpu.io.webapi import worker_table
+
+            s["workers"] = worker_table(ledger, name)
         out.append(s)
     if args.as_json:
         print(json.dumps(out, indent=2))
@@ -815,6 +823,19 @@ def _cmd_status(args, cfg: Dict[str, Any]) -> int:
                 if "promoted" in r:
                     line += f", {r['promoted']} promoted"
                 print(line)
+            for w in s.get("workers") or []:
+                age = w["last_seen_age_s"]
+                seen = f"last seen {age:.0f}s ago" if age is not None \
+                    else "never seen"
+                hold = (f", holds {', '.join(t[:8] for t in w['current'])}"
+                        if w["current"] else "")
+                counts = ", ".join(
+                    f"{w[k]} {k}" for k in
+                    ("completed", "broken", "interrupted", "suspended",
+                     "reserved")
+                    if w[k]
+                ) or "no trials"
+                print(f"  worker {w['worker']}: {counts} ({seen}{hold})")
     return 0
 
 

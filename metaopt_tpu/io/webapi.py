@@ -20,6 +20,8 @@ coordinator's write path:
   the ARD GP surrogate (mtpu plot importance)
 - ``GET /experiments/{name}/pareto``      → nondominated front over the
   trials' objective vectors (mtpu plot pareto; multi-objective runs)
+- ``GET /experiments/{name}/workers``     → per-worker liveness derived
+  from trial ownership + heartbeats (mtpu status --workers)
 - ``GET /healthz``                        → liveness
 
 Deliberately read-only: every write still flows through the single-writer
@@ -32,6 +34,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -75,6 +78,46 @@ def _experiment_detail(ledger: LedgerBackend, name: str) -> Optional[Dict[str, A
         return None
     s = Experiment(name, ledger).configure().stats
     return {**doc, "stats": {"by_status": s["by_status"], "best": s["best"]}}
+
+
+def worker_table(ledger: LedgerBackend, name: str) -> List[Dict[str, Any]]:
+    """Per-worker liveness derived from trial ownership + heartbeats.
+
+    The reference lineage's worker visibility came from querying Mongo for
+    reserved trials; here the same derivation is a first-class surface:
+    every trial records its owning worker, reserved trials carry the
+    heartbeat the executor pumps, finished trials keep their end time.
+    No extra registry — the ledger already knows. Shared by
+    ``mtpu status --workers`` and ``GET /experiments/{name}/workers``.
+    """
+    now = time.time()
+    workers: Dict[str, Dict[str, Any]] = {}
+    for t in ledger.fetch(name):
+        w = t.worker
+        if not w:
+            continue
+        rec = workers.setdefault(w, {
+            "worker": w, "reserved": 0, "completed": 0, "broken": 0,
+            "interrupted": 0, "suspended": 0, "current": [],
+            "last_seen": None,
+        })
+        if t.status in rec:
+            rec[t.status] += 1
+        if t.status == "reserved":
+            rec["current"].append(t.id)
+            seen = t.heartbeat or t.start_time
+        else:
+            seen = t.end_time or t.heartbeat
+        if seen and (rec["last_seen"] is None or seen > rec["last_seen"]):
+            rec["last_seen"] = seen
+    out = sorted(workers.values(),
+                 key=lambda r: -(r["last_seen"] or 0.0))
+    for r in out:
+        r["last_seen_age_s"] = (
+            round(now - r["last_seen"], 1)
+            if r["last_seen"] is not None else None
+        )
+    return out
 
 
 def completed_in_order(ledger: LedgerBackend, name: str):
@@ -435,7 +478,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "/experiments/{name}/lcurves",
                 "/experiments/{name}/parallel",
                 "/experiments/{name}/importance",
-                "/experiments/{name}/pareto", "/healthz",
+                "/experiments/{name}/pareto",
+                "/experiments/{name}/workers", "/healthz",
             ]}
         if parts == ["healthz"]:
             return 200, {"ok": True}
@@ -473,6 +517,8 @@ class _Handler(BaseHTTPRequestHandler):
             return importance_series(ledger, name)
         if parts[2] == "pareto":
             return pareto_series(ledger, name)
+        if parts[2] == "workers":
+            return 200, worker_table(ledger, name)
         return 404, {"error": f"unknown route /{'/'.join(parts)}"}
 
 

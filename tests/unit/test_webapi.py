@@ -168,3 +168,33 @@ def test_importance_endpoint():
     assert status == 200
     assert abs(sum(doc["importance"].values()) - 1.0) < 1e-6
     assert doc["importance"]["a"] > doc["importance"]["b"]
+
+
+def test_workers_endpoint(served):
+    code, rows = get(served + "/experiments/api/workers")
+    assert code == 200
+    assert len(rows) == 1 and rows[0]["worker"] == "w"
+    assert rows[0]["completed"] == 3
+    assert rows[0]["reserved"] == 0 and rows[0]["current"] == []
+    assert rows[0]["last_seen_age_s"] is not None
+
+
+def test_workers_shows_live_reservation():
+    from metaopt_tpu.io.webapi import worker_table
+
+    ledger = MemoryLedger()
+    space = build_space({"x": "uniform(-5, 5)"})
+    exp = Experiment("live", ledger, space=space, max_trials=5).configure()
+    exp.register_trials([exp.make_trial({"x": 1.0}),
+                         exp.make_trial({"x": 2.0})])
+    a = exp.reserve_trial("alpha")
+    exp.push_results(
+        a, [{"name": "o", "type": "objective", "value": 0.5}]
+    )
+    b = exp.reserve_trial("beta")   # still holding
+    rows = worker_table(ledger, "live")
+    byw = {r["worker"]: r for r in rows}
+    assert byw["alpha"]["completed"] == 1 and byw["alpha"]["current"] == []
+    assert byw["beta"]["reserved"] == 1 and byw["beta"]["current"] == [b.id]
+    # beta heartbeated more recently than alpha finished -> listed first
+    assert rows[0]["worker"] == "beta"
