@@ -12,6 +12,7 @@ from metaopt_tpu.benchmark.assessments import (
     AverageRank,
     AverageResult,
     Hypervolume,
+    ParallelAssessment,
     hypervolume_2d,
 )
 from metaopt_tpu.benchmark.benchmark import Benchmark, Study
@@ -30,6 +31,7 @@ __all__ = [
     "AverageRank",
     "AverageResult",
     "Hypervolume",
+    "ParallelAssessment",
     "hypervolume_2d",
     "Benchmark",
     "BenchmarkTask",

@@ -161,6 +161,91 @@ class Hypervolume(Assessment):
                             "reference_point": self.reference_point}}
 
 
+class ParallelAssessment(Assessment):
+    """How an algorithm holds up when N workers race one experiment.
+
+    ref: the lineage's ParallelAssessment — same trial budget, executed by
+    1 vs N concurrent workers against one shared ledger. Two questions:
+
+    - **quality**: asynchronous suggestion means later points are chosen
+      with stale observations (suggest happens while N−1 evaluations are
+      still in flight) — how much final regret does that cost?
+    - **throughput**: wall-clock speedup (and efficiency = speedup/N)
+      from the coordination plane. With in-process numpy tasks the GIL
+      bounds raw speedup; the number is still the honest cost of the
+      reserve/observe contention the workers actually experience.
+
+    The Benchmark runs each (algorithm, repetition) once per entry in
+    ``worker_counts``, recording series under ``algo@wN`` keys and wall
+    times alongside.
+    """
+
+    wants_walls = True
+
+    def __init__(self, repetitions: int = 2,
+                 worker_counts: List[int] = (1, 4)):
+        self.repetitions = int(repetitions)
+        # dedup: a repeated count would rebuild the SAME experiment name,
+        # join the finished run, and record a ~0s wall that fakes speedup
+        self.worker_counts = sorted({int(n) for n in worker_counts})
+        if any(n < 1 for n in self.worker_counts):
+            raise ValueError("worker_counts must be >= 1")
+
+    @staticmethod
+    def _split(key: str):
+        algo, _, w = key.rpartition("@w")
+        return algo, int(w)
+
+    def analyze(self, series, walls=None):
+        walls = walls or {}
+        per_algo: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        for key, runs in series.items():
+            algo, nw = self._split(key)
+            curves = _mean_curves(runs)
+            wall_list = walls.get(key) or []
+            per_algo.setdefault(algo, {})[nw] = {
+                "final_best": curves[-1] if curves else None,
+                "mean_wall_s": (round(sum(wall_list) / len(wall_list), 3)
+                                if wall_list else None),
+            }
+        table: Dict[str, Any] = {}
+        for algo, by_n in per_algo.items():
+            base = by_n.get(1) or {}
+            rows = {}
+            for nw in sorted(by_n):
+                row = dict(by_n[nw])
+                if nw != 1 and base.get("mean_wall_s") and row["mean_wall_s"]:
+                    sp = base["mean_wall_s"] / row["mean_wall_s"]
+                    row["speedup_vs_1w"] = round(sp, 2)
+                    row["efficiency"] = round(sp / nw, 2)
+                if nw != 1 and base.get("final_best") is not None \
+                        and row["final_best"] is not None:
+                    row["regret_penalty_vs_1w"] = (
+                        row["final_best"] - base["final_best"]
+                    )
+                rows[f"w{nw}"] = row
+            table[algo] = rows
+        top_n = max(self.worker_counts)
+        finals = {
+            a: rows.get(f"w{top_n}", {}).get("final_best")
+            for a, rows in table.items()
+        }
+        ranked = sorted((a for a, v in finals.items() if v is not None),
+                        key=finals.get)
+        return {
+            "assessment": "parallelassessment",
+            "repetitions": self.repetitions,
+            "worker_counts": self.worker_counts,
+            "algorithms": table,
+            "winner": ranked[0] if ranked else None,
+        }
+
+    @property
+    def configuration(self):
+        return {self.name: {"repetitions": self.repetitions,
+                            "worker_counts": self.worker_counts}}
+
+
 class AverageRank(Assessment):
     """Mean rank (1 = best) of each algorithm across repetitions.
 

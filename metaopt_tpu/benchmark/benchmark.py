@@ -40,17 +40,28 @@ class Study:
     def __init__(self, assessment: Assessment, task: BenchmarkTask):
         self.assessment = assessment
         self.task = task
-        #: algorithm name -> list (one per repetition) of regret series
+        #: series key -> list (one per repetition) of regret series. The
+        #: key is the algorithm name — suffixed ``@wN`` when the
+        #: assessment runs multiple worker counts (ParallelAssessment)
         self.series: Dict[str, List[List[float]]] = {}
+        #: series key -> wall-clock seconds per repetition
+        self.walls: Dict[str, List[float]] = {}
 
-    def record(self, algo: str, series: List[float]) -> None:
-        self.series.setdefault(algo, []).append(series)
+    def record(self, key: str, series: List[float],
+               wall_s: Optional[float] = None) -> None:
+        self.series.setdefault(key, []).append(series)
+        if wall_s is not None:
+            self.walls.setdefault(key, []).append(wall_s)
 
     def analyze(self) -> Dict[str, Any]:
+        extra = (
+            {"walls": self.walls}
+            if getattr(self.assessment, "wants_walls", False) else {}
+        )
         return {
             "task": self.task.name,
             "task_config": self.task.configuration,
-            **self.assessment.analyze(self.series),
+            **self.assessment.analyze(self.series, **extra),
         }
 
 
@@ -86,14 +97,16 @@ class Benchmark:
     # -- execution ---------------------------------------------------------
     def _run_one(
         self, study: Study, algo_name: str, algo_kwargs: Dict[str, Any],
-        repetition: int,
-    ) -> List[float]:
+        repetition: int, n_workers: int = 1,
+    ) -> Tuple[List[float], float]:
         from metaopt_tpu.space import build_space
 
         exp_name = (
             f"{self.name}-{study.task.name}-{study.assessment.name}-"
             f"{algo_name}-rep{repetition}"
         )
+        if n_workers != 1:
+            exp_name += f"-w{n_workers}"
         kwargs = dict(algo_kwargs)
         kwargs.setdefault("seed", repetition)
         exp = Experiment(
@@ -105,26 +118,72 @@ class Benchmark:
             pool_size=1,
             metadata={"benchmark": self.name},
         ).configure()
-        workon(exp, InProcessExecutor(study.task), worker_id=exp_name)
+        t0 = time.perf_counter()
+        if n_workers == 1:
+            workon(exp, InProcessExecutor(study.task), worker_id=exp_name)
+        else:
+            # N full workon loops racing one shared ledger — the same
+            # async-suggestion semantics as `hunt --n-workers` (each loop
+            # has its own Experiment handle; the reserve CAS arbitrates).
+            # Deliberately simpler than the CLI's thread loop
+            # (cli/main.py::_cmd_hunt): in-process tasks need no
+            # stop_event wind-down, per-thread executors, or coord
+            # socket handling
+            import threading
+
+            errors: Dict[int, str] = {}
+
+            def run(i: int) -> None:
+                try:
+                    w_exp = Experiment(exp_name, self.ledger).configure()
+                    workon(w_exp, InProcessExecutor(study.task),
+                           worker_id=f"{exp_name}-w{i}")
+                except BaseException as err:  # must surface, not vanish
+                    errors[i] = f"{type(err).__name__}: {err}"
+
+            threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                       for i in range(n_workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise RuntimeError(
+                    f"benchmark worker(s) died: {errors}"
+                )
+        wall_s = time.perf_counter() - t0
         # the assessment owns what "progress" means: best-so-far objective
         # by default, hypervolume-so-far for multi-objective studies
-        return study.assessment.series(self.ledger, exp_name,
-                                       task=study.task)
+        return (
+            study.assessment.series(self.ledger, exp_name, task=study.task),
+            wall_s,
+        )
 
     def process(self) -> None:
-        """Run every (study × algorithm × repetition) experiment."""
+        """Run every (study × algorithm × repetition [× workers]) run."""
         t0 = time.perf_counter()
         for study in self.studies:
+            raw = getattr(study.assessment, "worker_counts", None)
+            counts = raw or [1]
+            # an assessment that DECLARES worker counts always gets @wN
+            # keys (its analyze parses them), even for worker_counts=[1]
+            multi = raw is not None
             for spec in self.algorithms:
                 algo_name, algo_kwargs = _algo_config(spec)
                 for rep in range(study.assessment.repetitions):
-                    series = self._run_one(study, algo_name, algo_kwargs, rep)
-                    study.record(algo_name, series)
-                    log.info(
-                        "benchmark %s: %s/%s/%s rep %d -> best %s",
-                        self.name, study.task.name, study.assessment.name,
-                        algo_name, rep, series[-1] if series else None,
-                    )
+                    for nw in counts:
+                        series, wall_s = self._run_one(
+                            study, algo_name, algo_kwargs, rep, nw
+                        )
+                        key = (f"{algo_name}@w{nw}" if multi else algo_name)
+                        study.record(key, series, wall_s=wall_s)
+                        log.info(
+                            "benchmark %s: %s/%s/%s rep %d w%d -> best %s "
+                            "(%.1fs)",
+                            self.name, study.task.name,
+                            study.assessment.name, algo_name, rep, nw,
+                            series[-1] if series else None, wall_s,
+                        )
         self._processed = True
         log.info("benchmark %s processed in %.1fs",
                  self.name, time.perf_counter() - t0)

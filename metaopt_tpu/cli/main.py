@@ -245,11 +245,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="trial budget per repetition")
     bm.add_argument("--repetitions", type=int, default=3)
     bm.add_argument("--assessment", choices=("result", "rank",
-                                             "hypervolume"),
+                                             "hypervolume", "parallel"),
                     default="result",
                     help="result = mean best-so-far; rank = mean final "
                          "rank; hypervolume = mean dominated hypervolume "
-                         "(multi-objective tasks, e.g. zdt1)")
+                         "(multi-objective tasks, e.g. zdt1); parallel = "
+                         "same trial budget under 1 vs N racing workers "
+                         "(async-suggestion quality cost + wall-clock "
+                         "speedup)")
+    bm.add_argument("--workers", nargs="+", type=int, default=(1, 4),
+                    metavar="N",
+                    help="parallel assessment: worker counts to compare")
     bm.add_argument("--json", dest="as_json", action="store_true")
 
     srv = sub.add_parser(
@@ -1502,7 +1508,8 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
 def _cmd_benchmark(args, cfg) -> int:
     """Run one study (task × assessment) across the requested algorithms."""
     from metaopt_tpu.benchmark import (
-        AverageRank, AverageResult, Benchmark, Hypervolume, task_registry,
+        AverageRank, AverageResult, Benchmark, Hypervolume,
+        ParallelAssessment, task_registry,
     )
 
     try:
@@ -1511,8 +1518,16 @@ def _cmd_benchmark(args, cfg) -> int:
         print(f"unknown task {args.task!r}; have: "
               f"{', '.join(sorted(task_registry))}", file=sys.stderr)
         return 2
-    assess = {"rank": AverageRank, "hypervolume": Hypervolume}.get(
-        args.assessment, AverageResult)(args.repetitions)
+    if args.assessment == "parallel":
+        try:
+            assess = ParallelAssessment(args.repetitions,
+                                        worker_counts=args.workers)
+        except ValueError as err:
+            print(err, file=sys.stderr)
+            return 2
+    else:
+        assess = {"rank": AverageRank, "hypervolume": Hypervolume}.get(
+            args.assessment, AverageResult)(args.repetitions)
     task = task_cls(args.max_trials)
     if isinstance(assess, Hypervolume):
         try:  # detectable BEFORE any trial runs — don't waste a study
@@ -1553,6 +1568,22 @@ def _cmd_benchmark(args, cfg) -> int:
                                                   -(finals[a] or 0.0))):
             print(f"  {algo:<{width}}  final hypervolume = "
                   f"{_num(finals[algo])}")
+    if "algorithms" in report:  # parallel assessment table
+        for algo, rows in sorted(report["algorithms"].items()):
+            print(f"  {algo}:")
+            for wkey, row in sorted(
+                    rows.items(), key=lambda kv: int(kv[0][1:])):
+                line = (f"    {wkey:<4} final best = "
+                        f"{_num(row['final_best'])}")
+                if row.get("mean_wall_s") is not None:
+                    line += f", wall {row['mean_wall_s']:.2f}s"
+                if "speedup_vs_1w" in row:
+                    line += (f", speedup {row['speedup_vs_1w']}x "
+                             f"(eff {row['efficiency']})")
+                if "regret_penalty_vs_1w" in row:
+                    line += (f", regret penalty "
+                             f"{_num(row['regret_penalty_vs_1w'])}")
+                print(line)
     print(f"winner: {report['winner']}")
     return 0
 

@@ -150,3 +150,91 @@ class TestHypervolume:
         hv = Hypervolume(repetitions=1)
         with pytest.raises(ValueError, match="reference_point"):
             hv.series(MemoryLedger(), "x", task=Sphere())
+
+
+class TestParallelAssessment:
+    def test_runs_1_vs_2_workers_and_reports_speedup_fields(self):
+        from metaopt_tpu.benchmark import (
+            Benchmark, ParallelAssessment, RosenBrock,
+        )
+
+        bench = Benchmark(
+            "par",
+            algorithms=["random"],
+            targets=[{
+                "assess": [ParallelAssessment(1, worker_counts=(1, 2))],
+                "task": [RosenBrock(12)],
+            }],
+        )
+        bench.process()
+        (report,) = bench.analysis()
+        assert report["assessment"] == "parallelassessment"
+        rows = report["algorithms"]["random"]
+        assert set(rows) == {"w1", "w2"}
+        assert rows["w1"]["final_best"] is not None
+        assert rows["w2"]["mean_wall_s"] is not None
+        assert "speedup_vs_1w" in rows["w2"]
+        assert "regret_penalty_vs_1w" in rows["w2"]
+        assert report["winner"] == "random"
+        # the single-worker run used exactly the budget; the racing run
+        # may overshoot by a lost produce race (non-atomic budget check)
+        assert bench.ledger.count(
+            "par-rosenbrock-parallelassessment-random-rep0", "completed"
+        ) == 12
+        assert bench.ledger.count(
+            "par-rosenbrock-parallelassessment-random-rep0-w2", "completed"
+        ) >= 12
+
+    def test_worker_counts_validated(self):
+        from metaopt_tpu.benchmark import ParallelAssessment
+
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match=">= 1"):
+            ParallelAssessment(1, worker_counts=(0, 2))
+
+    def test_cli_parallel_assessment(self, capsys):
+        from metaopt_tpu.cli.main import main as cli_main
+
+        rc = cli_main(["benchmark", "--algos", "random", "--task",
+                       "sphere", "--max-trials", "8", "--repetitions",
+                       "1", "--assessment", "parallel", "--workers", "1",
+                       "2", "--json"])
+        assert rc == 0
+        import json as _json
+        out = capsys.readouterr().out
+        report = _json.loads(out)
+        assert report["worker_counts"] == [1, 2]
+        assert "w2" in report["algorithms"]["random"]
+
+
+    def test_single_worker_count_analyzes_cleanly(self):
+        from metaopt_tpu.benchmark import (
+            Benchmark, ParallelAssessment, Sphere,
+        )
+
+        bench = Benchmark(
+            "par1",
+            algorithms=["random"],
+            targets=[{
+                "assess": [ParallelAssessment(1, worker_counts=(1,))],
+                "task": [Sphere(6)],
+            }],
+        )
+        bench.process()
+        (report,) = bench.analysis()   # must not crash on key parsing
+        assert set(report["algorithms"]["random"]) == {"w1"}
+
+    def test_duplicate_worker_counts_deduped(self):
+        from metaopt_tpu.benchmark import ParallelAssessment
+
+        assert ParallelAssessment(1, worker_counts=(4, 4, 1)) \
+            .worker_counts == [1, 4]
+
+    def test_cli_rejects_bad_workers_cleanly(self, capsys):
+        from metaopt_tpu.cli.main import main as cli_main
+
+        rc = cli_main(["benchmark", "--algos", "random", "--task",
+                       "sphere", "--assessment", "parallel",
+                       "--workers", "0", "2"])
+        assert rc == 2
+        assert ">= 1" in capsys.readouterr().err
