@@ -71,6 +71,21 @@ class BaseAlgorithm(ABC):
     def _observe_one(self, trial: Trial) -> None:  # subclass hook
         pass
 
+    #: True when the instance wants the Producer to report in-flight
+    #: (reserved) trials each cycle via :meth:`set_pending` — the
+    #: lineage's parallel-strategy ("liar") mechanism
+    supports_pending: bool = False
+
+    def set_pending(self, trials: Sequence[Trial]) -> None:
+        """In-flight trials, for parallel-strategy algorithms. No-op here.
+
+        Called by the Producer each produce cycle (when
+        ``supports_pending``) with the experiment's reserved trials, so
+        an async algorithm can avoid re-suggesting near points whose
+        evaluations are still running. Ephemeral: never serialized in
+        ``state_dict``, never counted in ``n_observed``/``is_done``.
+        """
+
     @property
     def n_observed(self) -> int:
         return len(self._observed)

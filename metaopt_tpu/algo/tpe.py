@@ -67,6 +67,7 @@ class TPE(BaseAlgorithm):
         full_weight_num: int = 25,
         equal_weight: bool = False,
         pool_prefetch: int = 8,
+        parallel_strategy: Optional[str] = None,
         **config: Any,
     ):
         super().__init__(
@@ -79,6 +80,7 @@ class TPE(BaseAlgorithm):
             full_weight_num=full_weight_num,
             equal_weight=equal_weight,
             pool_prefetch=pool_prefetch,
+            parallel_strategy=parallel_strategy,
             **config,
         )
         self.n_initial_points = n_initial_points
@@ -89,9 +91,28 @@ class TPE(BaseAlgorithm):
         self.equal_weight = equal_weight
         self.pool_prefetch = max(1, int(pool_prefetch))
 
+        # parallel strategy (the lineage's "liar" mechanism): in-flight
+        # trials join the fit with a lie objective so concurrent workers
+        # don't pile suggestions onto points already being evaluated.
+        # mean = neutral lie, max = pessimistic (discourages revisiting)
+        if parallel_strategy not in (None, "none", "mean", "max"):
+            raise ValueError(
+                f"parallel_strategy must be one of none|mean|max, "
+                f"got {parallel_strategy!r}"
+            )
+        self.parallel_strategy = (
+            None if parallel_strategy in (None, "none") else parallel_strategy
+        )
+        self.supports_pending = self.parallel_strategy is not None
+
         self.cube = UnitCube(space)
         self._X: List[np.ndarray] = []   # unit-cube vectors, observation order
         self._y: List[float] = []
+        self._pending_X: List[np.ndarray] = []   # lie rows, ephemeral
+        self._pending_fp: tuple = ()
+        self._aug_key = None   # (n_obs, pending_fp) the aug buffers match
+        self._aug_X = self._aug_y = None
+        self._aug_n = 0
         #: max categories across dims (table width for the kernel)
         self._kmax = int(max(1, self.cube.n_choices.max()))
 
@@ -152,6 +173,38 @@ class TPE(BaseAlgorithm):
     def observe(self, trials: List[Trial]) -> None:
         with self._kernel_lock:
             super().observe(trials)
+        # with a parallel strategy the speculative refill waits for
+        # set_pending (the Producer calls it right after observe): firing
+        # here would race the pending update — a pool computed against
+        # the stale pending set, thrown away, with one PRNG pool index
+        # burned scheduling-dependently
+        if not self.supports_pending:
+            self._maybe_refill_async()
+
+    def set_pending(self, trials) -> None:
+        """Reserved trials join the next fit with a lie objective.
+
+        Ephemeral by design: rows live only until the pending set changes
+        (fingerprinted by trial id), lie VALUES are recomputed at launch
+        time from the live observations, and nothing here is serialized
+        or counted toward ``is_done``. A changed pending set invalidates
+        the prefetch pool — its points were chosen against a stale fit.
+        For pending-enabled instances this is also the speculative-refill
+        trigger (see observe); a caller that observes but never reports
+        pending just loses the prefetch overlap, not correctness.
+        """
+        if self.parallel_strategy is None:
+            return
+        with self._kernel_lock:
+            live = [t for t in trials if t.id not in self._observed]
+            fp = tuple(sorted(t.id for t in live))
+            if fp != self._pending_fp:
+                self._pending_fp = fp
+                self._pending_X = [
+                    self.cube.transform(t.params) for t in live
+                ]
+                self._prefetch = []
+                self._prefetch_n_obs = -1
         self._maybe_refill_async()
 
     # -- suggest -----------------------------------------------------------
@@ -408,10 +461,42 @@ class TPE(BaseAlgorithm):
         # pad the pool axis to a power of two: the producer's pool size
         # shrinks near max_trials, and n_out is a static (compile-time) shape
         n_out = pad_pow2(num, minimum=1)
+        X_dev, y_dev, n_eff = self._Xdev, self._ydev, n
+        if self._pending_X and self.parallel_strategy is not None and n > 0:
+            # lie rows ride as extra observations; values derive from the
+            # live fit (mean = neutral, max = pessimistic), so a completed
+            # trial's truth replaces its lie on the next cycle. NaN
+            # objectives (diverged trials, legal input — argsort sends
+            # them to the bad set) must not poison the lie
+            lie = (float(np.nanmean(self._y))
+                   if self.parallel_strategy == "mean"
+                   else float(np.nanmax(self._y)))
+            if np.isfinite(lie):
+                aug_key = (n, self._pending_fp)
+                if self._aug_key != aug_key:
+                    # build once per (fit, pending-set) change, not per
+                    # launch — the incremental _sync_device cache still
+                    # covers the base rows
+                    npend = len(self._pending_X)
+                    ntot = n + npend
+                    need = pad_pow2(ntot + 1)
+                    d = self.cube.n_dims
+                    Xa = np.full((need, d), 0.5, np.float32)
+                    ya = np.full(need, np.inf, np.float32)
+                    Xa[:n] = self._Xbuf[:n]
+                    ya[:n] = self._ybuf[:n]
+                    Xa[n:ntot] = np.asarray(self._pending_X, np.float32)
+                    ya[n:ntot] = lie
+                    self._aug_key = aug_key
+                    self._aug_X = jnp.asarray(Xa)
+                    self._aug_y = jnp.asarray(ya)
+                    self._aug_n = ntot
+                X_dev, y_dev = self._aug_X, self._aug_y
+                n_eff = self._aug_n
         best = np.asarray(
             tpe_suggest_fused(
-                self._Xdev, self._ydev,
-                n, count, fit_key,
+                X_dev, y_dev,
+                n_eff, count, fit_key,
                 self._n_choices_dev, self._cont_mask_dev,
                 self.gamma, self.prior_weight, self.full_weight_num,
                 n_cand=self.n_ei_candidates,

@@ -59,6 +59,11 @@ class Producer:
                     len(usable), len(fetched), src,
                 )
         self.algorithm.observe(exp.fetch_completed_trials())
+        if getattr(self.algorithm, "supports_pending", False):
+            # parallel strategy (lineage "liar"): in-flight trials join
+            # the fit with a lie objective so N racing workers don't pile
+            # suggestions onto points already being evaluated
+            self.algorithm.set_pending(exp.fetch_trials("reserved"))
         self.timings["observe_s"] += time.perf_counter() - t0
         self.timings["cycles"] += 1
 
