@@ -207,6 +207,7 @@ class GPBO(BaseAlgorithm):
         fit_iters: int = 60,
         fit_lr: float = 0.05,
         pool_prefetch: int = 4,
+        parallel_strategy: Optional[str] = None,
         **config: Any,
     ):
         super().__init__(
@@ -217,6 +218,7 @@ class GPBO(BaseAlgorithm):
             fit_iters=fit_iters,
             fit_lr=fit_lr,
             pool_prefetch=pool_prefetch,
+            parallel_strategy=parallel_strategy,
             **config,
         )
         self.n_initial_points = n_initial_points
@@ -224,9 +226,23 @@ class GPBO(BaseAlgorithm):
         self.fit_iters = fit_iters
         self.fit_lr = fit_lr
         self.pool_prefetch = max(1, int(pool_prefetch))
+        # the classic async-GP "constant liar": pending points join the
+        # fit with a lie objective (mean = CL-mean, max = CL-max). Shares
+        # the TPE liar's producer protocol (set_pending) and semantics
+        if parallel_strategy not in (None, "none", "mean", "max"):
+            raise ValueError(
+                f"parallel_strategy must be one of none|mean|max, "
+                f"got {parallel_strategy!r}"
+            )
+        self.parallel_strategy = (
+            None if parallel_strategy in (None, "none") else parallel_strategy
+        )
+        self.supports_pending = self.parallel_strategy is not None
         self.cube = UnitCube(space)
         self._X: List[np.ndarray] = []
         self._y: List[float] = []
+        self._pending_X: List[np.ndarray] = []   # lie rows, ephemeral
+        self._pending_fp: tuple = ()
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
         # pooled suggestions from the last launch, valid while the fit
         # (observation count) is unchanged — same doctrine as TPE: the
@@ -244,6 +260,25 @@ class GPBO(BaseAlgorithm):
         self._X.append(self.cube.transform(trial.params))
         self._y.append(float(trial.objective))
 
+    def set_pending(self, trials) -> None:
+        """Reserved trials become constant-liar rows for the next fit.
+
+        Same contract as TPE.set_pending: ephemeral, never serialized,
+        never counted toward ``is_done``; the truth replaces the lie the
+        cycle the trial completes; a changed pending set invalidates the
+        prefetch pool (its points priced in a stale fit).
+        """
+        if self.parallel_strategy is None:
+            return
+        live = [t for t in trials if t.id not in self._observed]
+        fp = tuple(sorted(t.id for t in live))
+        if fp == self._pending_fp:
+            return
+        self._pending_fp = fp
+        self._pending_X = [self.cube.transform(t.params) for t in live]
+        self._prefetch = []
+        self._prefetch_n_obs = -1
+
     # -- suggest -----------------------------------------------------------
     def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
         if len(self._y) < self.n_initial_points:
@@ -256,29 +291,49 @@ class GPBO(BaseAlgorithm):
             out = self._prefetch[:num]
             self._prefetch = self._prefetch[num:]
             return out
-        n = len(self._y)
+        n_total = len(self._y)
+        # a diverged trial's NaN/inf objective would poison the WHOLE fit
+        # through the mean/std standardization — exclude it from the GP
+        # entirely (TPE-by-argsort sends such rows to the bad set; a GP
+        # has no analogous refuge)
+        finite = [(x, v) for x, v in zip(self._X, self._y)
+                  if np.isfinite(v)]
+        X_rows = [x for x, _ in finite]
+        y_list = [v for _, v in finite]
+        if not y_list:  # every observation diverged: explore uniformly
+            return [self.space.sample(1, seed=self.rng)[0]
+                    for _ in range(num)]
+        if self._pending_X and self.parallel_strategy is not None:
+            # the constant lie, from the finite observations only
+            lie = (float(np.mean(y_list))
+                   if self.parallel_strategy == "mean"
+                   else float(np.max(y_list)))
+            X_rows = X_rows + self._pending_X
+            y_list = y_list + [lie] * len(self._pending_X)
+        n_eff = len(y_list)
         d = self.cube.n_dims
-        npad = pad_pow2(n)
+        npad = pad_pow2(n_eff)
         X = np.zeros((npad, d), np.float32)
-        X[:n] = np.stack(self._X)
-        y_raw = np.asarray(self._y, np.float32)
+        X[:n_eff] = np.stack(X_rows)
+        y_raw = np.asarray(y_list, np.float32)
         # standardize: MLL fit assumes O(1) targets
         mu, sd = float(y_raw.mean()), float(y_raw.std() + 1e-8)
         y = np.zeros(npad, np.float32)
-        y[:n] = (y_raw - mu) / sd
+        y[:n_eff] = (y_raw - mu) / sd
         fit_mask = np.zeros(npad, np.float32)
-        fit_mask[:n] = 1.0
-        if self._pool_n != n:
-            self._pool_n, self._pool_idx = n, 0
+        fit_mask[:n_eff] = 1.0
+        if self._pool_n != n_total:
+            self._pool_n, self._pool_idx = n_total, 0
         key = jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(self._kernel_seed), n),
+            jax.random.fold_in(jax.random.PRNGKey(self._kernel_seed),
+                               n_total),
             self._pool_idx,
         )
         self._pool_idx += 1
         n_out = pad_pow2(max(num, self.pool_prefetch), minimum=1)
         best = np.asarray(gp_suggest_fused(
             jnp.asarray(X), jnp.asarray(y), jnp.asarray(fit_mask),
-            float(y[:n].min()), key, self.fit_lr,
+            float(y[:n_eff].min()), key, self.fit_lr,
             fit_iters=self.fit_iters,
             n_cand=pad_pow2(self.n_candidates),
             n_out=n_out,
@@ -291,7 +346,7 @@ class GPBO(BaseAlgorithm):
                 pt[fid.name] = fid.high
             pts.append(pt)
         out, self._prefetch = pts[:num], pts[num:]
-        self._prefetch_n_obs = n
+        self._prefetch_n_obs = n_total
         return out
 
     def seed_rng(self, seed: Optional[int]) -> None:

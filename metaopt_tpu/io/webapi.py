@@ -306,6 +306,10 @@ svg text { fill: var(--muted); font-size: 11px; }
 <div id="chart"></div>
 <h2 id="pareto-title" hidden></h2>
 <div id="pareto"></div>
+<h2 id="workers-title" hidden></h2>
+<table id="workers" hidden><thead><tr><th>worker</th><th>completed</th>
+<th>broken</th><th>holds</th><th>last seen</th></tr></thead>
+<tbody></tbody></table>
 <script>
 const W=640, H=220, PAD=42;
 async function j(u){ const r=await fetch(u); return r.json(); }
@@ -417,6 +421,7 @@ async function show(name){
   const r=await j('/experiments/'+encodeURIComponent(name)+'/regret');
   if(name!==selected) return;  // a newer click superseded this fetch
   drawRegret(name, (r.regret||[]).map(d=>[d.trial, d.best]));
+  drawWorkers(name);
   // multi-objective runs additionally get the front scatter; a 400 from
   // a single-objective run just hides the section
   try{
@@ -427,6 +432,36 @@ async function show(name){
   if(name!==selected) return;
   document.getElementById('pareto-title').hidden=true;
   document.getElementById('pareto').innerHTML='';
+}
+async function drawWorkers(name){
+  // per-worker liveness (the status --workers table): reserved holders
+  // with a fresh heartbeat read as live; long-silent rows read as gone
+  try{
+    const rows=await j('/experiments/'+encodeURIComponent(name)+'/workers');
+    if(name!==selected) return;
+    const title=document.getElementById('workers-title');
+    const table=document.getElementById('workers');
+    if(!rows.length){ title.hidden=true; table.hidden=true; return; }
+    title.hidden=false; table.hidden=false;
+    title.textContent=name+' — workers ('+rows.length+')';
+    const tb=table.querySelector('tbody'); tb.innerHTML='';
+    for(const w of rows){
+      const age=w.last_seen_age_s;
+      const seen=age==null?'never':
+        age<120?fmt(age)+'s ago':fmt(age/60)+'m ago';
+      const tr=document.createElement('tr');
+      tr.innerHTML=`<td>${esc(w.worker)}</td><td>${esc(w.completed)}</td>
+        <td>${esc(w.broken)}</td>
+        <td>${esc((w.current||[]).map(t=>t.slice(0,8)).join(' ')||'—')}</td>
+        <td>${esc(seen)}</td>`;
+      tb.appendChild(tr);
+    }
+  }catch(e){
+    // a failed fetch must not leave the PREVIOUS experiment's rows
+    // mislabeled under the new selection
+    document.getElementById('workers-title').hidden=true;
+    document.getElementById('workers').hidden=true;
+  }
 }
 refresh(); setInterval(refresh, 5000);
 </script></body></html>"""

@@ -53,8 +53,10 @@ class TestStrategyConfig:
     def test_supports_pending_flag(self):
         assert TPE(_space()).supports_pending is False
         assert TPE(_space(), parallel_strategy="mean").supports_pending
-        assert "parallel_strategy" in TPE(
-            _space(), parallel_strategy="max").configuration["tpe"] or True
+        # the liar setting must survive the experiment-document round trip
+        # (coordinator restart rebuilds algorithms from .configuration)
+        cfg = TPE(_space(), parallel_strategy="max").configuration["tpe"]
+        assert cfg["parallel_strategy"] == "max"
 
 
 class TestLies:
@@ -163,3 +165,61 @@ class TestLieRobustness:
         tpe.set_pending([_reserved(space, {"x": 0.2, "y": 0.2})])
         tpe.suggest(1)
         assert tpe._aug_key != key1  # fit changed -> rebuilt once
+
+
+class TestGPConstantLiar:
+    def _seeded_gp(self, strategy=None, n=8):
+        from metaopt_tpu.algo.gp_bo import GPBO
+
+        space = _space()
+        gp = GPBO(space, seed=5, n_initial_points=4, n_candidates=32,
+                  fit_iters=8, pool_prefetch=2, parallel_strategy=strategy)
+        rng = np.random.RandomState(1)
+        for _ in range(n):
+            x, y = float(rng.rand()), float(rng.rand())
+            gp.observe(
+                [_completed(space, {"x": x, "y": y}, (x - 0.4) ** 2 + y)]
+            )
+        return space, gp
+
+    def test_lies_change_the_stream_and_stay_ephemeral(self):
+        space, a = self._seeded_gp(strategy="max")
+        _, b = self._seeded_gp(strategy="max")
+        assert a.supports_pending and b.supports_pending
+        n0 = b.n_observed
+        state0 = b.state_dict()
+        b.set_pending([_reserved(space, {"x": 0.4, "y": 0.02})])
+        assert b.n_observed == n0
+        assert b.state_dict() == state0
+        assert a.suggest(2) != b.suggest(2)
+
+    def test_unknown_strategy_rejected(self):
+        from metaopt_tpu.algo.gp_bo import GPBO
+
+        with pytest.raises(ValueError, match="none\\|mean\\|max"):
+            GPBO(_space(), parallel_strategy="kriging")
+
+    def test_nan_observation_excluded_from_fit(self):
+        space, gp = self._seeded_gp(strategy="mean")
+        gp.observe([_completed(space, {"x": 0.99, "y": 0.99},
+                               float("nan"))])
+        gp.set_pending([_reserved(space, {"x": 0.2, "y": 0.2})])
+        pts = gp.suggest(2)
+        assert len(pts) == 2
+        # the fit itself must stay finite: every suggested point is a
+        # real unit-cube point, not NaN fallout
+        for pt in pts:
+            assert all(np.isfinite(v) for v in pt.values())
+            assert pt in space
+
+    def test_all_nan_observations_fall_back_to_uniform(self):
+        from metaopt_tpu.algo.gp_bo import GPBO
+
+        space = _space()
+        gp = GPBO(space, seed=5, n_initial_points=2, n_candidates=16,
+                  fit_iters=4)
+        for i in range(4):
+            gp.observe([_completed(space, {"x": 0.1 * (i + 1), "y": 0.5},
+                                   float("nan"))])
+        pts = gp.suggest(3)
+        assert len(pts) == 3 and all(p in space for p in pts)

@@ -198,3 +198,12 @@ def test_workers_shows_live_reservation():
     assert byw["beta"]["reserved"] == 1 and byw["beta"]["current"] == [b.id]
     # beta heartbeated more recently than alpha finished -> listed first
     assert rows[0]["worker"] == "beta"
+
+
+def test_dashboard_includes_workers_panel(served):
+    import urllib.request as _rq
+    with _rq.urlopen(served + "/dashboard", timeout=10) as r:
+        html = r.read().decode()
+    assert 'id="workers"' in html
+    assert "drawWorkers" in html
+    assert "/workers'" in html or "/workers')" in html.replace('"', "'")
