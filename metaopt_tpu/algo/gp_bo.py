@@ -172,6 +172,94 @@ def _fit_ard(X, y, mask, fit_lr, *, fit_iters: int):
     return params
 
 
+def _fit_surrogate(X, y, *, fit_iters: int = 80, fit_lr: float = 0.05):
+    """Shared surrogate-fit preamble for importance + partial dependence.
+
+    Drops non-finite objectives (a diverged trial must not poison either
+    analysis), pow2-pads, standardizes, and runs the jitted ARD fit.
+    Returns ``(params, Xp, yp, mask, mu, sd, X_finite)``. Raises
+    ValueError when fewer than 2 finite rows remain — both analyses are
+    meaningless below that.
+    """
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    keep = np.isfinite(y)
+    X, y = X[keep], y[keep]
+    n, d = X.shape
+    if n < 2:
+        raise ValueError("surrogate analysis needs >= 2 finite trials")
+    mu, sd = float(y.mean()), float(y.std() + 1e-8)
+    npad = pad_pow2(max(n, 2))
+    Xp = np.zeros((npad, d), np.float32)
+    Xp[:n] = X
+    yp = np.zeros(npad, np.float32)
+    yp[:n] = (y - mu) / sd
+    mask = np.zeros(npad, np.float32)
+    mask[:n] = 1.0
+    params = _fit_ard(jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask),
+                      fit_lr, fit_iters=fit_iters)
+    return params, Xp, yp, mask, mu, sd, X
+
+
+@jax.jit
+def _posterior_alpha(X, y, mask, params):
+    """One Cholesky for the whole analysis: the mean needs only alpha."""
+    K = _masked_gram(X, mask, params["log_ls"], params["log_amp"],
+                     params["log_noise"])
+    L = jnp.linalg.cholesky(K)
+    return jax.scipy.linalg.cho_solve((L, True), y * mask)
+
+
+@jax.jit
+def _mean_from_alpha(X, mask, params, alpha, Xq):
+    Ks = _kernel(X, Xq, params["log_ls"], params["log_amp"]) * mask[:, None]
+    return Ks.T @ alpha
+
+
+def partial_dependence(
+    X: np.ndarray, y: np.ndarray, *, n_grid: int = 24,
+    max_background: int = 64, fit_iters: int = 80, fit_lr: float = 0.05,
+    seed: int = 0,
+):
+    """(grid, curves): 1-D partial dependence of each dim under the GP.
+
+    ref: the lineage's ``plot partial_dependencies`` — computed from the
+    SAME fitted ARD surrogate that serves importance (shared
+    ``_fit_surrogate``), on-device. For each dimension d and grid value
+    g, the curve is the posterior mean averaged over background points
+    drawn from the OBSERVED data (the classic PDP estimator),
+    de-standardized back to objective units. The Gram matrix is factored
+    ONCE (``_posterior_alpha``); per-dim queries then cost one
+    kernel-matvec launch each. X: (n, d) unit-cube points; y: (n,) raw
+    objectives (non-finite rows dropped; ValueError below 2 finite).
+    Returns ``grid`` (n_grid,) in [0, 1], ``curves`` (d, n_grid).
+    """
+    params, Xp, yp, mask, mu, sd, Xf = _fit_surrogate(
+        X, y, fit_iters=fit_iters, fit_lr=fit_lr
+    )
+    n, d = Xf.shape
+    alpha = _posterior_alpha(jnp.asarray(Xp), jnp.asarray(yp),
+                             jnp.asarray(mask), params)
+    rng = np.random.RandomState(seed)
+    bg = Xf if n <= max_background else Xf[
+        rng.choice(n, max_background, replace=False)
+    ]
+    grid = ((np.arange(n_grid) + 0.5) / n_grid).astype(np.float32)
+    curves = np.zeros((d, n_grid), np.float64)
+    Xp_dev, mask_dev = jnp.asarray(Xp), jnp.asarray(mask)
+    for j in range(d):
+        # (G·B, d) queries: background rows with dim j pinned per grid
+        # value — kept per-dim so the kernel slab stays O(npad · G·B)
+        Q = np.repeat(bg[None, :, :], n_grid, axis=0)      # (G, B, d)
+        Q[:, :, j] = grid[:, None]
+        m = np.asarray(_mean_from_alpha(
+            Xp_dev, mask_dev, params, alpha,
+            jnp.asarray(Q.reshape(-1, d)),
+        )).reshape(n_grid, len(bg))
+        curves[j] = m.mean(axis=1) * sd + mu
+    return grid, curves
+
+
 def ard_importance(
     X: np.ndarray, y: np.ndarray, *, fit_iters: int = 80, fit_lr: float = 0.05
 ) -> np.ndarray:
@@ -180,18 +268,11 @@ def ard_importance(
     The ARD RBF's sensitivity along dimension d scales as 1/lengthscale²:
     a short lengthscale means the objective bends quickly along that axis
     (the lineage's LPI role, computed from the surrogate this framework
-    already runs on-device). X in the unit cube (n, d); y raw objectives.
+    already runs on-device). X in the unit cube (n, d); y raw objectives
+    (non-finite rows dropped — shared ``_fit_surrogate`` preamble, so
+    importance and partial dependence read the identical surrogate).
     """
-    n, d = X.shape
-    npad = pad_pow2(max(n, 2))
-    Xp = np.zeros((npad, d), np.float32)
-    Xp[:n] = X
-    yp = np.zeros(npad, np.float32)
-    yp[:n] = (y - y.mean()) / (y.std() + 1e-8)
-    mask = np.zeros(npad, np.float32)
-    mask[:n] = 1.0
-    params = _fit_ard(jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask),
-                      fit_lr, fit_iters=fit_iters)
+    params, *_ = _fit_surrogate(X, y, fit_iters=fit_iters, fit_lr=fit_lr)
     inv_sq = np.asarray(jnp.exp(-2.0 * params["log_ls"]), np.float64)
     return inv_sq / max(inv_sq.sum(), 1e-12)
 

@@ -155,6 +155,7 @@ def build_parser() -> argparse.ArgumentParser:
     plot = sub.add_parser("plot", help="optimization diagnostics")
     plot.add_argument("kind",
                       choices=["regret", "lcurve", "parallel", "importance",
+                               "pdp",
                                "pareto"],
                       help="regret: best-objective-so-far per completed "
                            "trial; lcurve: objective vs fidelity budget per "
@@ -162,7 +163,9 @@ def build_parser() -> argparse.ArgumentParser:
                            "parallel-coordinates data (params + objective "
                            "per completed trial, JSON); importance: "
                            "per-parameter importance from a fitted ARD GP "
-                           "surrogate (the lineage's LPI role); pareto: "
+                           "surrogate (the lineage's LPI role); pdp: 1-D "
+                           "partial dependence of each parameter under "
+                           "the same surrogate; pareto: "
                            "nondominated front over the trials' objective "
                            "vectors (multi-objective experiments)")
     common(plot)
@@ -906,6 +909,8 @@ def _cmd_plot(args, cfg: Dict[str, Any]) -> int:
         return _plot_parallel(args, ledger)
     if args.kind == "importance":
         return _plot_importance(args, ledger)
+    if args.kind == "pdp":
+        return _plot_pdp(args, ledger)
     if args.kind == "pareto":
         return _plot_pareto(args, ledger)
     points = regret_series(ledger, args.name)
@@ -1004,6 +1009,41 @@ def _plot_importance(args, ledger) -> int:
     for name, v in pairs:
         bar = "#" * max(1, int(v * 40))
         print(f"  {name:<{width}}  {v:6.1%}  {bar}")
+    return 0
+
+
+def _plot_pdp(args, ledger) -> int:
+    """1-D partial dependence per parameter (fitted ARD GP surrogate).
+
+    ref: the lineage's ``plot partial_dependencies`` — shared with
+    GET /experiments/{name}/pdp. Text mode renders each parameter's mean
+    curve as a sparkline (low objective = tall bar = better region) with
+    the minimizing x highlighted.
+    """
+    from metaopt_tpu.io.webapi import pdp_series
+
+    code, payload = pdp_series(ledger, args.name)
+    if code != 200:
+        print(payload.get("error", "partial dependence unavailable"))
+        return 1
+    if args.as_json:
+        print(json.dumps(payload, indent=2))
+        return 0
+    blocks = "▁▂▃▄▅▆▇█"
+    print(f"partial dependence ({args.name}, ARD GP over "
+          f"{payload['trials']} completed trials; taller = lower "
+          f"objective = better):")
+    width = max(len(n) for n in payload["pdp"])
+    for pname, curve in payload["pdp"].items():
+        ys = curve["mean"]
+        lo, hi = min(ys), max(ys)
+        span = (hi - lo) or 1.0
+        spark = "".join(
+            blocks[int((hi - v) / span * (len(blocks) - 1))] for v in ys
+        )
+        bx = curve["x"][ys.index(lo)]
+        bxs = f"{bx:.4g}" if isinstance(bx, float) else str(bx)
+        print(f"  {pname:<{width}}  {spark}  min {lo:.4g} at {bxs}")
     return 0
 
 

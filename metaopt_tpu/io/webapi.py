@@ -22,6 +22,8 @@ coordinator's write path:
   trials' objective vectors (mtpu plot pareto; multi-objective runs)
 - ``GET /experiments/{name}/workers``     → per-worker liveness derived
   from trial ownership + heartbeats (mtpu status --workers)
+- ``GET /experiments/{name}/pdp``         → 1-D partial dependence per
+  parameter under the fitted ARD GP (mtpu plot pdp)
 - ``GET /healthz``                        → liveness
 
 Deliberately read-only: every write still flows through the single-writer
@@ -159,6 +161,39 @@ def parallel_series(ledger: LedgerBackend, name: str):
     return dims, rows
 
 
+def _surrogate_inputs(ledger: LedgerBackend, name: str):
+    """Shared loader for the GP-surrogate analyses (importance, pdp).
+
+    Returns ``((cube, X, y), None)`` or ``(None, (status, payload))``.
+    Only FINITE objectives count toward the 4-trial floor — a diverged
+    (NaN/inf) trial contributes nothing to either analysis, and letting
+    it through would turn a user-data condition into a 500 downstream.
+    Column naming comes from ``cube.names`` (fidelity dims excluded,
+    shaped dims expanded), the exact layout the fitted surrogate sees —
+    ``space.keys()`` would misalign on any multi-fidelity experiment.
+    """
+    import math
+
+    from metaopt_tpu.space import UnitCube, build_space
+
+    doc = ledger.load_experiment(name) or {}
+    if not doc.get("space"):
+        return None, (400, {"error": f"{name!r} has no stored space"})
+    space = build_space(doc["space"])
+    done = [t for t in ledger.fetch(name, "completed")
+            if t.objective is not None and math.isfinite(t.objective)]
+    if len(done) < 4:
+        return None, (400, {"error": f"need at least 4 completed trials "
+                                     f"with finite objectives, have "
+                                     f"{len(done)}"})
+    import numpy as np
+
+    cube = UnitCube(space)
+    X = np.stack([cube.transform(t.params) for t in done])
+    y = np.asarray([t.objective for t in done], np.float32)
+    return (cube, X, y), None
+
+
 def importance_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
     """(status, payload) for GET /experiments/{name}/importance.
 
@@ -166,26 +201,39 @@ def importance_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
     metaopt_tpu.algo.gp_bo.ard_importance); shares the exact computation
     with `mtpu plot importance`.
     """
-    import numpy as np
-
     from metaopt_tpu.algo.gp_bo import ard_importance
-    from metaopt_tpu.space import UnitCube, build_space
 
-    doc = ledger.load_experiment(name) or {}
-    if not doc.get("space"):
-        return 400, {"error": f"{name!r} has no stored space"}
-    space = build_space(doc["space"])
-    done = [t for t in ledger.fetch(name, "completed")
-            if t.objective is not None]
-    if len(done) < 4:
-        return 400, {"error": f"need at least 4 completed trials, "
-                              f"have {len(done)}"}
-    cube = UnitCube(space)
-    X = np.stack([cube.transform(t.params) for t in done])
-    y = np.asarray([t.objective for t in done], np.float32)
+    inputs, err = _surrogate_inputs(ledger, name)
+    if err is not None:
+        return err
+    cube, X, y = inputs
     imp = ard_importance(X, y)
-    return 200, {"experiment": name, "trials": len(done),
-                 "importance": dict(zip(space.keys(), imp.tolist()))}
+    return 200, {"experiment": name, "trials": len(y),
+                 "importance": dict(zip(cube.names, imp.tolist()))}
+
+
+def pdp_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
+    """(status, payload) for GET /experiments/{name}/pdp.
+
+    1-D partial dependence of each parameter under the fitted ARD GP
+    (metaopt_tpu.algo.gp_bo.partial_dependence — the lineage's
+    ``plot partial_dependencies`` role); shared with `mtpu plot pdp`.
+    Grid x-values are reported in each cube column's NATIVE scale
+    (fidelity dims excluded, shaped dims one curve per element).
+    """
+    from metaopt_tpu.algo.gp_bo import partial_dependence
+
+    inputs, err = _surrogate_inputs(ledger, name)
+    if err is not None:
+        return err
+    cube, X, y = inputs
+    grid, curves = partial_dependence(X, y)
+    out = {}
+    for j, pname in enumerate(cube.names):
+        dim = cube.dims[j]
+        xs = [cube._bwd_one(dim, float(g)) for g in grid]
+        out[pname] = {"x": xs, "mean": curves[j].tolist()}
+    return 200, {"experiment": name, "trials": len(y), "pdp": out}
 
 
 def pareto_series(ledger: LedgerBackend, name: str) -> Tuple[int, Any]:
@@ -514,6 +562,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/experiments/{name}/parallel",
                 "/experiments/{name}/importance",
                 "/experiments/{name}/pareto",
+                "/experiments/{name}/pdp",
                 "/experiments/{name}/workers", "/healthz",
             ]}
         if parts == ["healthz"]:
@@ -554,6 +603,8 @@ class _Handler(BaseHTTPRequestHandler):
             return pareto_series(ledger, name)
         if parts[2] == "workers":
             return 200, worker_table(ledger, name)
+        if parts[2] == "pdp":
+            return pdp_series(ledger, name)
         return 404, {"error": f"unknown route /{'/'.join(parts)}"}
 
 

@@ -158,3 +158,42 @@ def test_pool_drain_draws_fresh_candidates():
         key = space.hash_point(pt)
         assert key not in seen, "re-served an already-issued suggestion"
         seen.add(key)
+
+
+class TestPartialDependence:
+    def test_curve_minimum_tracks_the_true_optimum(self):
+        import numpy as np
+
+        from metaopt_tpu.algo.gp_bo import partial_dependence
+
+        rng = np.random.RandomState(0)
+        X = rng.rand(40, 2).astype(np.float32)
+        # objective depends on dim 0 only, minimized at 0.7
+        y = (X[:, 0] - 0.7) ** 2 + 0.01 * rng.randn(40)
+        grid, curves = partial_dependence(X, y, n_grid=20)
+        assert curves.shape == (2, 20)
+        best_g = grid[np.argmin(curves[0])]
+        assert abs(best_g - 0.7) < 0.15
+        # the irrelevant dim's curve is comparatively flat
+        assert np.ptp(curves[1]) < np.ptp(curves[0]) * 0.5
+
+    def test_nonfinite_rows_dropped(self):
+        import numpy as np
+
+        from metaopt_tpu.algo.gp_bo import partial_dependence
+
+        X = np.random.RandomState(1).rand(12, 1).astype(np.float32)
+        y = (X[:, 0] - 0.5) ** 2
+        y[3] = float("nan")
+        grid, curves = partial_dependence(X, y, n_grid=8)
+        assert np.all(np.isfinite(curves))
+
+    def test_too_few_trials_raises(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from metaopt_tpu.algo.gp_bo import partial_dependence
+
+        with _pytest.raises(ValueError, match=">= 2"):
+            partial_dependence(np.zeros((1, 2), np.float32),
+                               np.zeros(1, np.float32))

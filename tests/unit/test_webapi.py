@@ -207,3 +207,75 @@ def test_dashboard_includes_workers_panel(served):
     assert 'id="workers"' in html
     assert "drawWorkers" in html
     assert "/workers'" in html or "/workers')" in html.replace('"', "'")
+
+
+def test_pdp_endpoint(served):
+    # the fixture seeds 3 completed trials: below the 4-trial floor
+    with pytest.raises(urllib.error.HTTPError) as err:
+        get(served + "/experiments/api/pdp")
+    assert err.value.code == 400
+    from metaopt_tpu.io.webapi import pdp_series
+
+    ledger = MemoryLedger()
+    space = build_space({"x": "uniform(0, 1)"})
+    exp = Experiment("p", ledger, space=space, max_trials=10).configure()
+    for i in range(6):
+        t = exp.make_trial({"x": i / 6 + 0.05})
+        exp.register_trials([t])
+        got = exp.reserve_trial("w")
+        exp.push_results(
+            got, [{"name": "o", "type": "objective",
+                   "value": (i / 6 - 0.5) ** 2}]
+        )
+    code, payload = pdp_series(ledger, "p")
+    assert code == 200
+    curve = payload["pdp"]["x"]
+    assert len(curve["x"]) == len(curve["mean"]) == 24
+
+
+def test_surrogate_endpoints_with_fidelity_and_nan():
+    """importance/pdp must align to cube columns (fidelity excluded) and
+    treat NaN-heavy histories as a 400, not a 500."""
+    import math
+
+    from metaopt_tpu.io.webapi import importance_series, pdp_series
+
+    ledger = MemoryLedger()
+    space = build_space({"lr": "loguniform(1e-4, 1e-1)",
+                         "width": "uniform(8, 64, discrete=True)",
+                         "epochs": "fidelity(1, 8, base=2)"})
+    exp = Experiment("fid", ledger, space=space, max_trials=30).configure()
+    for i in range(8):
+        t = exp.make_trial({"lr": 10 ** (-1 - i * 0.3), "width": 8 + 4 * i,
+                            "epochs": 8})
+        exp.register_trials([t])
+        got = exp.reserve_trial("w")
+        exp.push_results(
+            got, [{"name": "o", "type": "objective",
+                   "value": (i - 3) ** 2 * 0.1}]
+        )
+    code, imp = importance_series(ledger, "fid")
+    assert code == 200
+    assert set(imp["importance"]) == {"lr", "width"}  # fidelity excluded
+    code, pdp = pdp_series(ledger, "fid")
+    assert code == 200
+    assert set(pdp["pdp"]) == {"lr", "width"}
+    assert all(math.isfinite(v) for v in pdp["pdp"]["lr"]["mean"])
+    # integers come back in native scale
+    assert all(isinstance(v, int) for v in pdp["pdp"]["width"]["x"])
+
+    # NaN-heavy history: fewer than 4 finite trials -> clean 400
+    exp2 = Experiment("nanex", ledger,
+                      space=build_space({"x": "uniform(0, 1)"}),
+                      max_trials=30).configure()
+    for i in range(6):
+        t = exp2.make_trial({"x": i / 7})
+        exp2.register_trials([t])
+        got = exp2.reserve_trial("w")
+        exp2.push_results(
+            got, [{"name": "o", "type": "objective",
+                   "value": float("nan") if i > 1 else 0.5}]
+        )
+    for fn in (importance_series, pdp_series):
+        code, payload = fn(ledger, "nanex")
+        assert code == 400 and "finite" in payload["error"]
