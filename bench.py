@@ -499,7 +499,8 @@ def main() -> None:
     # burn minutes of driver budget nobody wants; the TPU story rides along
     # from the last committed TPU run instead
     stages = (
-        ("transformer-256", "transformer-512", "resnet", "flash")
+        ("transformer-256", "transformer-512", "transformer-1024",
+         "resnet", "flash")
         if on_tpu else ()
     )
     for name in stages:

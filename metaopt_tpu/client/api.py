@@ -206,6 +206,79 @@ class ExperimentClient:
     def fetch_trials(self, status: Optional[str] = None) -> List[Trial]:
         return self._exp.ledger.fetch(self._exp.name, status)
 
+    def to_pandas(self, with_evc_tree: bool = False):
+        """The experiment's trials as a DataFrame (lineage ``to_pandas``).
+
+        One row per trial: id, status, timing, worker, the objective, and
+        the params flattened into ``params.<name>`` columns. With
+        ``with_evc_tree`` the frame also includes every ancestor/child
+        version's trials (a ``experiment`` column disambiguates), walking
+        ``branch_parent`` links both ways the way the lineage's EVC
+        fetches do.
+        """
+        try:
+            import pandas as pd
+        except ImportError as err:  # declared in the [pandas]/[test] extras
+            raise ImportError(
+                "ExperimentClient.to_pandas needs pandas "
+                "(pip install metaopt-tpu[pandas])"
+            ) from err
+
+        from metaopt_tpu.ledger.evc import branch_parent
+
+        ledger = self._exp.ledger
+        names = [self._exp.name]
+        if with_evc_tree:
+            doc = ledger.load_experiment(self._exp.name) or {}
+            seen = {self._exp.name}
+            parent = branch_parent(doc)
+            while parent and parent not in seen:  # ancestors
+                seen.add(parent)
+                names.insert(0, parent)
+                pdoc = ledger.load_experiment(parent) or {}
+                parent = branch_parent(pdoc)
+            # descendants: parent -> children map first, then expand to a
+            # fixpoint — a single sorted pass would drop a grandchild
+            # listed before its parent (e.g. fam-v10 < fam-v2)
+            children: Dict[str, List[str]] = {}
+            for other in ledger.list_experiments():
+                if other in seen:
+                    continue
+                odoc = ledger.load_experiment(other) or {}
+                p = branch_parent(odoc)
+                if p:
+                    children.setdefault(p, []).append(other)
+            frontier = list(names)
+            while frontier:
+                kids = [
+                    c for p in frontier for c in sorted(children.get(p, []))
+                    if c not in seen
+                ]
+                seen.update(kids)
+                names.extend(kids)
+                frontier = kids
+        rows = []
+        for name in names:
+            for t in ledger.fetch(name):
+                row = {
+                    "experiment": name,
+                    "id": t.id,
+                    "status": t.status,
+                    "worker": t.worker,
+                    "submit_time": t.submit_time,
+                    "start_time": t.start_time,
+                    "end_time": t.end_time,
+                    "objective": t.objective,
+                }
+                for k, v in t.params.items():
+                    row[f"params.{k}"] = v
+                rows.append(row)
+        base_cols = ["experiment", "id", "status", "worker", "submit_time",
+                     "start_time", "end_time", "objective"]
+        if not rows:  # keep the documented schema even when empty
+            return pd.DataFrame(columns=base_cols)
+        return pd.DataFrame(rows)
+
     def pareto_front(self) -> List[Tuple[Dict[str, Any], List[float]]]:
         """Nondominated ``(params, objective_vector)`` pairs (multi-
         objective experiments; ranking shared with motpe / plot pareto)."""

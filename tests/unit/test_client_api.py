@@ -137,3 +137,86 @@ class TestManualLoop:
         exp.experiment.ledger.release_stale(exp.name, 60.0)
         with pytest.raises(RuntimeError, match="NOT recorded"):
             exp.observe(t, 0.3)
+
+
+class TestToPandas:
+    def test_dataframe_columns_and_rows(self, tmp_path):
+        pytest.importorskip("pandas")
+        from metaopt_tpu.client.api import build_experiment
+
+        client = build_experiment(
+            "pdx", space={"x": "uniform(0, 1)"},
+            algorithm={"random": {"seed": 1}}, max_trials=4,
+            ledger="memory",
+        )
+        client.workon(lambda p: (p["x"] - 0.5) ** 2)
+        df = client.to_pandas()
+        assert len(df) == 4
+        assert {"id", "status", "objective", "params.x",
+                "experiment"} <= set(df.columns)
+        assert (df["status"] == "completed").all()
+        assert df["objective"].min() >= 0.0
+
+    def test_evc_tree_includes_family(self, tmp_path):
+        pytest.importorskip("pandas")
+        from metaopt_tpu.cli.main import main as cli_main
+        from metaopt_tpu.client.api import build_experiment
+
+        led = str(tmp_path / "l")
+        cli_main(["init-only", "-n", "fam", "--ledger", led,
+                  "--", "x.py", "-x~uniform(0, 1)"])
+        cli_main(["init-only", "-n", "fam", "--ledger", led,
+                  "--on-conflict", "branch",
+                  "--", "x.py", "-x~uniform(0, 5)"])
+        client = build_experiment("fam-v2", ledger=led)
+        client.experiment.register_trials(
+            [client.experiment.make_trial({"x": 2.5})]
+        )
+        df = client.to_pandas(with_evc_tree=True)
+        assert set(df["experiment"]) <= {"fam", "fam-v2"}
+        assert "fam-v2" in set(df["experiment"])
+
+
+    def test_empty_experiment_keeps_schema(self):
+        pytest.importorskip("pandas")
+        from metaopt_tpu.client.api import build_experiment
+
+        client = build_experiment(
+            "empty", space={"x": "uniform(0, 1)"}, max_trials=4,
+            ledger="memory",
+        )
+        df = client.to_pandas()
+        assert len(df) == 0
+        assert "status" in df.columns and "objective" in df.columns
+
+    def test_evc_tree_reaches_grandchildren_sorted_before_parents(
+            self, tmp_path):
+        pytest.importorskip("pandas")
+        from metaopt_tpu.cli.main import main as cli_main
+        from metaopt_tpu.client.api import build_experiment
+        from metaopt_tpu.ledger.backends import ledger_from_spec
+
+        led = str(tmp_path / "l")
+        cli_main(["init-only", "-n", "fam", "--ledger", led,
+                  "--", "x.py", "-x~uniform(0, 1)"])
+        cli_main(["init-only", "-n", "fam", "--ledger", led,
+                  "--on-conflict", "branch",
+                  "--", "x.py", "-x~uniform(0, 5)"])     # fam-v2
+        # a grandchild whose name sorts BEFORE its parent fam-v2
+        ledger = ledger_from_spec(led)
+        doc = dict(ledger.load_experiment("fam-v2"))
+        doc.update(name="fam-v10", version=10, parent="fam-v2")
+        doc.pop("metadata", None)
+        ledger.create_experiment(doc)
+        df = build_experiment("fam", ledger=led).to_pandas(
+            with_evc_tree=True
+        )
+        # no trials yet, but the walk itself must include all 3 versions
+        client = build_experiment("fam-v10", ledger=led)
+        client.experiment.register_trials(
+            [client.experiment.make_trial({"x": 2.0})]
+        )
+        df = build_experiment("fam", ledger=led).to_pandas(
+            with_evc_tree=True
+        )
+        assert "fam-v10" in set(df["experiment"])
