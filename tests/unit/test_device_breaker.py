@@ -1,0 +1,163 @@
+"""TPU-executor device circuit breaker: park on a wedged backend.
+
+The failure mode from the real v5e relay (it wedges mid-sweep): every
+launched trial burns its full wall-clock timeout, breaks, and three
+breakages abort the worker via max_broken — an infrastructure flap ends
+the hunt. The breaker turns this into: one timeout-shaped breakage arms
+suspicion, the next launch probes the backend in a disposable child, and
+while it is unreachable the executor PARKS (pumping the reservation's
+heartbeat) instead of feeding trials to a dead chip.
+"""
+
+import time
+
+import pytest
+
+from metaopt_tpu.executor.base import ExecutionResult
+from metaopt_tpu.executor.tpu import TPUExecutor
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space.builder import SpaceBuilder
+
+
+def make_executor(monkeypatch, tmp_path, probe, tpu_env=True, **kw):
+    import tempfile
+
+    monkeypatch.setenv("MTPU_SLICE_CHIPS", "4")
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    if tpu_env:
+        # the conftest forces JAX_PLATFORMS=cpu, which correctly DISARMS
+        # the breaker; these tests simulate a relay-attached environment
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    _, template = SpaceBuilder().build(["t.py", "-x~uniform(0, 1)"])
+    return TPUExecutor(template, n_chips=1, probe_fn=probe, **kw)
+
+
+def trial(i=0):
+    t = Trial(params={"x": 0.5}, experiment="e")
+    t.id = f"breaker-{i:04d}"
+    t.transition("reserved")
+    return t
+
+
+class TestBreaker:
+    def test_timeout_breakage_arms_suspicion(self, monkeypatch, tmp_path):
+        ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: True)
+        monkeypatch.setattr(
+            TPUExecutor.__mro__[1], "execute",
+            lambda self, t, heartbeat=None, judge=None: ExecutionResult(
+                "broken", note="timeout after 900.0s"),
+        )
+        assert not ex._suspect_device
+        res = ex.execute(trial(0))
+        assert res.status == "broken"
+        assert ex._suspect_device
+
+    def test_non_timeout_breakage_does_not_arm(self, monkeypatch, tmp_path):
+        ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: True)
+        monkeypatch.setattr(
+            TPUExecutor.__mro__[1], "execute",
+            lambda self, t, heartbeat=None, judge=None: ExecutionResult(
+                "broken", note="exit code 1; stderr tail: boom"),
+        )
+        ex.execute(trial(0))
+        assert not ex._suspect_device
+
+    def test_parks_until_device_returns(self, monkeypatch, tmp_path):
+        calls = {"n": 0}
+
+        def probe(**_):
+            calls["n"] += 1
+            return calls["n"] >= 3  # down for two probes, then back
+
+        ex = make_executor(monkeypatch, tmp_path, probe=probe,
+                           park_poll_s=0.05, park_max_s=30.0)
+        ex._suspect_device = True
+        beats = {"n": 0}
+
+        def heartbeat():
+            beats["n"] += 1
+            return True
+
+        monkeypatch.setattr(
+            TPUExecutor.__mro__[1], "execute",
+            lambda self, t, heartbeat=None, judge=None: ExecutionResult(
+                "completed", results=[{"name": "o", "type": "objective",
+                                       "value": 1.0}]),
+        )
+        res = ex.execute(trial(1), heartbeat=heartbeat)
+        assert res.status == "completed"
+        assert calls["n"] == 3
+        assert beats["n"] >= 1, "the reservation must stay alive while parked"
+        assert not ex._suspect_device
+
+    def test_gives_up_after_park_budget(self, monkeypatch, tmp_path):
+        ex = make_executor(monkeypatch, tmp_path,
+                           probe=lambda **_: False,
+                           park_poll_s=0.02, park_max_s=0.1)
+        ex._suspect_device = True
+        t0 = time.time()
+        res = ex.execute(trial(2))
+        assert res.status == "interrupted"
+        assert "unreachable" in res.note and "parked" in res.note
+        assert time.time() - t0 < 10.0
+        assert ex._suspect_device, "still suspect: next trial parks again"
+
+    def test_lost_reservation_while_parked(self, monkeypatch, tmp_path):
+        ex = make_executor(monkeypatch, tmp_path,
+                           probe=lambda **_: False,
+                           park_poll_s=0.02, park_max_s=30.0)
+        ex._suspect_device = True
+        res = ex.execute(trial(3), heartbeat=lambda: False)
+        assert res.status == "interrupted"
+        assert "lost reservation" in res.note
+
+
+    def test_cpu_environment_never_arms(self, monkeypatch, tmp_path):
+        ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: False,
+                           tpu_env=False)  # conftest: JAX_PLATFORMS=cpu
+        monkeypatch.setattr(
+            TPUExecutor.__mro__[1], "execute",
+            lambda self, t, heartbeat=None, judge=None: ExecutionResult(
+                "broken", note="timeout after 4.0s"),
+        )
+        ex.execute(trial(4))
+        assert not ex._suspect_device, \
+            "a CPU-only box must not park behind an unprobeable device"
+
+    def test_stderr_mentioning_timeout_does_not_arm(
+            self, monkeypatch, tmp_path):
+        ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: True)
+        monkeypatch.setattr(
+            TPUExecutor.__mro__[1], "execute",
+            lambda self, t, heartbeat=None, judge=None: ExecutionResult(
+                "broken",
+                note="exit=1; stderr tail: urllib connection timeout"),
+        )
+        ex.execute(trial(5))
+        assert not ex._suspect_device
+
+    def test_heartbeats_pump_during_a_slow_probe(
+            self, monkeypatch, tmp_path):
+        def slow_probe(**_):
+            time.sleep(6.0)   # longer than the 2s beat cadence
+            return True
+
+        ex = make_executor(monkeypatch, tmp_path, probe=slow_probe)
+        ex._suspect_device = True
+        beats = {"n": 0}
+
+        def heartbeat():
+            beats["n"] += 1
+            return True
+
+        monkeypatch.setattr(
+            TPUExecutor.__mro__[1], "execute",
+            lambda self, t, heartbeat=None, judge=None: ExecutionResult(
+                "completed", results=[{"name": "o", "type": "objective",
+                                       "value": 1.0}]),
+        )
+        res = ex.execute(trial(6), heartbeat=heartbeat)
+        assert res.status == "completed"
+        assert beats["n"] >= 2, \
+            "the reservation must beat WHILE the probe child runs"
