@@ -17,6 +17,7 @@ TPU-era additions beyond the reference:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import signal
 import subprocess
@@ -32,6 +33,8 @@ from metaopt_tpu.client import (
 )
 from metaopt_tpu.executor.base import ExecutionResult, Executor, HeartbeatFn, JudgeFn
 from metaopt_tpu.executor.faults import faults
+
+log = logging.getLogger(__name__)
 
 
 def _stop_path(results_path: str) -> str:
@@ -56,6 +59,10 @@ class SubprocessExecutor(Executor):
         profile_dir: Optional[str] = None,
         ckpt_root: Optional[str] = None,
         jax_cache_dir: Optional[str] = None,
+        device_probe_timeout_s: float = 90.0,
+        park_max_s: float = 1800.0,
+        park_poll_s: float = 60.0,
+        probe_fn=None,
     ):
         self.template = template
         self.working_dir = working_dir
@@ -95,6 +102,95 @@ class SubprocessExecutor(Executor):
                 jax.config.update(
                     "jax_persistent_cache_min_compile_time_secs", 1
                 )
+        # device circuit breaker (failure detection, SURVEY.md §5): a
+        # relay/runtime wedge makes EVERY trial burn its full wall-clock
+        # timeout and break — three of those and the worker's max_broken
+        # guard aborts the hunt over an infrastructure flap. After a
+        # timeout-shaped breakage (in a TPU-expecting environment only),
+        # probe the backend in a disposable child before the next launch;
+        # while unreachable, PARK (pumping the reservation heartbeat)
+        # instead of feeding more trials to a dead chip. Lives here, not
+        # only in TPUExecutor: un-pinned hunts through a relay (the
+        # 5-config smoke) hit the identical failure mode.
+        from metaopt_tpu.utils.procs import tpu_backend_reachable
+
+        self.device_probe_timeout_s = device_probe_timeout_s
+        self.park_max_s = park_max_s
+        self.park_poll_s = park_poll_s
+        self._probe = probe_fn or tpu_backend_reachable
+        self._suspect_device = False
+
+    # -- device circuit breaker --------------------------------------------
+    @staticmethod
+    def _device_expected() -> bool:
+        """Is there a TPU this environment is SUPPOSED to reach?
+
+        Distinguishes "no TPU ever" (breaker stays disarmed — on a CPU
+        box the probe returns False by design and would park every trial
+        after one slow script) from "TPU stopped answering" (park).
+        Mirrors the environment signals ``tpu_backend_reachable`` keys on.
+        """
+        platforms = (os.environ.get("JAX_PLATFORMS") or "").strip()
+        if platforms == "cpu":
+            return False
+        if os.environ.get("PALLAS_AXON_POOL_IPS"):  # relay-tunneled chip
+            return True
+        if "tpu" in platforms or "axon" in platforms:
+            return True
+        import glob
+
+        return bool(glob.glob("/dev/accel*"))  # directly-attached runtime
+
+    def _probe_with_beats(self, heartbeat: Optional[HeartbeatFn]):
+        """Run the (blocking, up to 90s) probe while pumping heartbeats.
+
+        The probe child outlives the stale-reservation window — going
+        silent for its whole duration would let another worker steal the
+        trial mid-probe. Returns True/False (probe verdict) or None when
+        the reservation was lost while waiting.
+        """
+        import threading
+
+        out: Dict[str, bool] = {}
+
+        def run() -> None:
+            out["ok"] = bool(
+                self._probe(timeout_s=self.device_probe_timeout_s)
+            )
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        while th.is_alive():
+            if heartbeat and not heartbeat():
+                return None  # probe child dies on its own deadline
+            th.join(timeout=2.0)
+        return out.get("ok", False)
+
+    def _await_device(self, heartbeat: Optional[HeartbeatFn]) -> str:
+        """Probe until the backend answers; park (beating) while it won't.
+
+        ``"ok"`` = device reachable (suspicion cleared); ``"budget"`` =
+        park budget exhausted; ``"lost"`` = reservation lost meanwhile.
+        """
+        deadline = time.time() + self.park_max_s
+        while True:
+            verdict = self._probe_with_beats(heartbeat)
+            if verdict is None:
+                return "lost"
+            if verdict:
+                self._suspect_device = False
+                return "ok"
+            if time.time() >= deadline:
+                return "budget"
+            log.warning(
+                "TPU backend unreachable; parking %.1fs before re-probe "
+                "(not launching trials at a dead device)", self.park_poll_s,
+            )
+            sleep_until = time.time() + self.park_poll_s
+            while time.time() < min(sleep_until, deadline):
+                if heartbeat and not heartbeat():
+                    return "lost"
+                time.sleep(min(5.0, self.park_poll_s))
 
     # -- env/argv assembly -------------------------------------------------
     def _prepare(self, trial: Trial, tmpdir: str) -> tuple[List[str], Dict[str, str], str]:
@@ -148,6 +244,40 @@ class SubprocessExecutor(Executor):
 
     # -- main --------------------------------------------------------------
     def execute(
+        self,
+        trial: Trial,
+        heartbeat: Optional[HeartbeatFn] = None,
+        judge: Optional[JudgeFn] = None,
+    ) -> ExecutionResult:
+        if self._suspect_device:
+            outcome = self._await_device(heartbeat)
+            if outcome == "lost":
+                return ExecutionResult(
+                    "interrupted",
+                    note="lost reservation while parked at an "
+                         "unreachable TPU backend",
+                )
+            if outcome == "budget":
+                return ExecutionResult(
+                    "interrupted",
+                    note=f"TPU backend unreachable; parked "
+                    f"{self.park_max_s:.0f}s without recovery (trial "
+                    f"released for retry — see `mtpu resume`)",
+                )
+        result = self._execute_inner(trial, heartbeat, judge)
+        # arm ONLY on the executor's own wall-clock-timeout note (a
+        # script's stderr tail may mention "timeout" for other reasons)
+        if (result.status == "broken"
+                and (result.note or "").startswith("timeout after")
+                and self._device_expected()):
+            self._suspect_device = True
+            log.warning(
+                "trial %s broke by timeout — probing the TPU backend "
+                "before the next launch", trial.id[:8],
+            )
+        return result
+
+    def _execute_inner(
         self,
         trial: Trial,
         heartbeat: Optional[HeartbeatFn] = None,

@@ -13,7 +13,7 @@ from __future__ import annotations
 import logging
 import os
 import time
-from typing import Dict, List, Optional
+from typing import Optional
 
 from metaopt_tpu.executor.base import ExecutionResult, HeartbeatFn, JudgeFn
 from metaopt_tpu.executor.subproc import SubprocessExecutor
@@ -39,28 +39,13 @@ class TPUExecutor(SubprocessExecutor):
         registry_path: Optional[str] = None,
         allocate_timeout_s: float = 600.0,
         allocate_poll_s: float = 0.5,
-        device_probe_timeout_s: float = 90.0,
-        park_max_s: float = 1800.0,
-        park_poll_s: float = 60.0,
-        probe_fn=None,
         **kwargs,
     ):
+        # the device circuit breaker (park at a wedged backend) lives in
+        # SubprocessExecutor — un-pinned relay hunts hit the identical
+        # failure mode; its knobs (park_max_s, probe_fn, ...) pass
+        # through **kwargs
         super().__init__(template, **kwargs)
-        # device circuit breaker (failure detection, SURVEY.md §5): a
-        # relay/runtime wedge makes EVERY trial burn its full wall-clock
-        # timeout and break — three of those and the worker's max_broken
-        # guard aborts the hunt over an infrastructure flap. After a
-        # timeout-shaped breakage, probe the backend in a disposable
-        # child before launching the next trial; while unreachable, PARK
-        # (pump the reservation's heartbeat, poll the device) instead of
-        # feeding more trials to a dead chip.
-        from metaopt_tpu.utils.procs import tpu_backend_reachable
-
-        self.device_probe_timeout_s = device_probe_timeout_s
-        self.park_max_s = park_max_s
-        self.park_poll_s = park_poll_s
-        self._probe = probe_fn or tpu_backend_reachable
-        self._suspect_device = False
         self.n_chips = int(n_chips)
         total = total_chips or detect_slice_size()
         # round the slice size down to a power of two for the buddy allocator
@@ -87,21 +72,6 @@ class TPUExecutor(SubprocessExecutor):
         heartbeat: Optional[HeartbeatFn] = None,
         judge: Optional[JudgeFn] = None,
     ) -> ExecutionResult:
-        if self._suspect_device:
-            outcome = self._await_device(heartbeat)
-            if outcome == "lost":
-                return ExecutionResult(
-                    "interrupted",
-                    note="lost reservation while parked at an "
-                         "unreachable TPU backend",
-                )
-            if outcome == "budget":
-                return ExecutionResult(
-                    "interrupted",
-                    note=f"TPU backend unreachable; parked "
-                    f"{self.park_max_s:.0f}s without recovery (trial "
-                    f"released for retry — see `mtpu resume`)",
-                )
         block = self._acquire(trial, heartbeat)
         if block is None:
             return ExecutionResult(
@@ -121,93 +91,13 @@ class TPUExecutor(SubprocessExecutor):
             return heartbeat() if heartbeat else True
 
         try:
-            result = super().execute(trial, heartbeat=beating, judge=judge)
+            # the inherited breaker parks/arms inside (while holding the
+            # sub-slice — nothing else can use it during a wedge anyway,
+            # and `beating` keeps both the reservation and the registry
+            # lease alive)
+            return super().execute(trial, heartbeat=beating, judge=judge)
         finally:
             self.registry.free(block)  # every exit path returns the sub-slice
-        # arm ONLY on the executor's own wall-clock-timeout note (the
-        # exact shape subproc.py emits) — a script's stderr tail may
-        # mention "timeout" for unrelated reasons — and only where a TPU
-        # is actually expected: on a CPU-only box the probe returns False
-        # by design and would park every trial after one slow script
-        if (result.status == "broken"
-                and (result.note or "").startswith("timeout after")
-                and self._device_expected()):
-            self._suspect_device = True
-            log.warning(
-                "trial %s broke by timeout — probing the TPU backend "
-                "before the next launch", trial.id[:8],
-            )
-        return result
-
-    @staticmethod
-    def _device_expected() -> bool:
-        """Is there a TPU this environment is SUPPOSED to reach?
-
-        Distinguishes "no TPU ever" (breaker must stay disarmed) from
-        "TPU stopped answering" (park). Mirrors the environment signals
-        ``tpu_backend_reachable`` keys on.
-        """
-        platforms = (os.environ.get("JAX_PLATFORMS") or "").strip()
-        if platforms == "cpu":
-            return False
-        if os.environ.get("PALLAS_AXON_POOL_IPS"):  # relay-tunneled chip
-            return True
-        if "tpu" in platforms or "axon" in platforms:
-            return True
-        import glob
-
-        return bool(glob.glob("/dev/accel*"))  # directly-attached runtime
-
-    def _probe_with_beats(self, heartbeat: Optional[HeartbeatFn]):
-        """Run the (blocking, up to 90s) probe while pumping heartbeats.
-
-        The probe child outlives the stale-reservation window — going
-        silent for its whole duration would let another worker steal the
-        trial mid-probe. Returns True/False (probe verdict) or None when
-        the reservation was lost while waiting.
-        """
-        import threading
-
-        out: Dict[str, bool] = {}
-
-        def run() -> None:
-            out["ok"] = bool(
-                self._probe(timeout_s=self.device_probe_timeout_s)
-            )
-
-        th = threading.Thread(target=run, daemon=True)
-        th.start()
-        while th.is_alive():
-            if heartbeat and not heartbeat():
-                return None  # probe child dies on its own deadline
-            th.join(timeout=2.0)
-        return out.get("ok", False)
-
-    def _await_device(self, heartbeat: Optional[HeartbeatFn]) -> str:
-        """Probe until the backend answers; park (beating) while it won't.
-
-        ``"ok"`` = device reachable (suspicion cleared); ``"budget"`` =
-        park budget exhausted; ``"lost"`` = reservation lost meanwhile.
-        """
-        deadline = time.time() + self.park_max_s
-        while True:
-            verdict = self._probe_with_beats(heartbeat)
-            if verdict is None:
-                return "lost"
-            if verdict:
-                self._suspect_device = False
-                return "ok"
-            if time.time() >= deadline:
-                return "budget"
-            log.warning(
-                "TPU backend unreachable; parking %.1fs before re-probe "
-                "(not launching trials at a dead device)", self.park_poll_s,
-            )
-            sleep_until = time.time() + self.park_poll_s
-            while time.time() < min(sleep_until, deadline):
-                if heartbeat and not heartbeat():
-                    return "lost"
-                time.sleep(min(5.0, self.park_poll_s))
 
     def _acquire(
         self, trial: Trial, heartbeat: Optional[HeartbeatFn]

@@ -44,7 +44,7 @@ class TestBreaker:
     def test_timeout_breakage_arms_suspicion(self, monkeypatch, tmp_path):
         ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: True)
         monkeypatch.setattr(
-            TPUExecutor.__mro__[1], "execute",
+            TPUExecutor.__mro__[1], "_execute_inner",
             lambda self, t, heartbeat=None, judge=None: ExecutionResult(
                 "broken", note="timeout after 900.0s"),
         )
@@ -56,7 +56,7 @@ class TestBreaker:
     def test_non_timeout_breakage_does_not_arm(self, monkeypatch, tmp_path):
         ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: True)
         monkeypatch.setattr(
-            TPUExecutor.__mro__[1], "execute",
+            TPUExecutor.__mro__[1], "_execute_inner",
             lambda self, t, heartbeat=None, judge=None: ExecutionResult(
                 "broken", note="exit code 1; stderr tail: boom"),
         )
@@ -80,7 +80,7 @@ class TestBreaker:
             return True
 
         monkeypatch.setattr(
-            TPUExecutor.__mro__[1], "execute",
+            TPUExecutor.__mro__[1], "_execute_inner",
             lambda self, t, heartbeat=None, judge=None: ExecutionResult(
                 "completed", results=[{"name": "o", "type": "objective",
                                        "value": 1.0}]),
@@ -117,7 +117,7 @@ class TestBreaker:
         ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: False,
                            tpu_env=False)  # conftest: JAX_PLATFORMS=cpu
         monkeypatch.setattr(
-            TPUExecutor.__mro__[1], "execute",
+            TPUExecutor.__mro__[1], "_execute_inner",
             lambda self, t, heartbeat=None, judge=None: ExecutionResult(
                 "broken", note="timeout after 4.0s"),
         )
@@ -129,7 +129,7 @@ class TestBreaker:
             self, monkeypatch, tmp_path):
         ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: True)
         monkeypatch.setattr(
-            TPUExecutor.__mro__[1], "execute",
+            TPUExecutor.__mro__[1], "_execute_inner",
             lambda self, t, heartbeat=None, judge=None: ExecutionResult(
                 "broken",
                 note="exit=1; stderr tail: urllib connection timeout"),
@@ -152,7 +152,7 @@ class TestBreaker:
             return True
 
         monkeypatch.setattr(
-            TPUExecutor.__mro__[1], "execute",
+            TPUExecutor.__mro__[1], "_execute_inner",
             lambda self, t, heartbeat=None, judge=None: ExecutionResult(
                 "completed", results=[{"name": "o", "type": "objective",
                                        "value": 1.0}]),
