@@ -179,6 +179,15 @@ def _partial_progress(ledger_path: str, name: str, wall_s: float) -> dict:
         return {"partial_error": str(exc)[:120]}
 
 
+def _annotate_failure(out: dict, on_cpu: bool) -> dict:
+    """Post-mortem for every failed TPU-backed config line: was the RELAY
+    still answering right after? A judge reading the record can then tell
+    an infrastructure flap from a real regression without re-deriving it."""
+    if not on_cpu:
+        out["relay_ok_after"] = tpu_backend_reachable(timeout_s=60.0)
+    return out
+
+
 def run_config(name: str, spec: dict, scale: str, ledger_root: str,
                backend: str, config_timeout_s: float) -> dict:
     max_trials = spec["max_trials"][scale]
@@ -241,19 +250,19 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str,
         out.update(_partial_progress(
             os.path.join(ledger_root, name), name, config_timeout_s
         ))
-        return out
+        return _annotate_failure(out, on_cpu)
     wall = time.time() - t0
 
     out = {"config": name, "trials": max_trials, "wall_s": round(wall, 1),
            "backend": "cpu" if on_cpu else backend}
     if proc.returncode != 0:
         out["error"] = stderr[-500:]
-        return out
+        return _annotate_failure(out, on_cpu)
     try:
         summary = json.loads(stdout[stdout.index("{"):])
     except (ValueError, json.JSONDecodeError):
         out["error"] = "unparseable hunt output"
-        return out
+        return _annotate_failure(out, on_cpu)
     completed = summary["total"].get("completed", 0)
     out.update(
         trials=completed,

@@ -255,3 +255,59 @@ def test_hosted_producer_serves_cohort_and_surrogate_algorithms():
             assert ledger.count(name, "completed") == 14, name
     finally:
         server.stop()
+
+
+def test_hosted_producer_reports_pending_to_liar_algorithms():
+    """producer_mode='coord' + TPE parallel_strategy: the coordinator's
+    hosted Producer must feed reserved trials into set_pending — the liar
+    mechanism works identically whether the fit is local or hosted."""
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+    from metaopt_tpu.executor import InProcessExecutor
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+    from metaopt_tpu.worker import workon
+
+    server = CoordServer().start()
+    host, port = server.address
+    try:
+        algo = {"tpe": {"seed": 0, "n_initial_points": 3,
+                        "parallel_strategy": "mean"}}
+        ledger = CoordLedgerClient(host=host, port=port)
+        space = build_space({"x": "uniform(-5, 5)"})
+        exp = Experiment("liar-coord", ledger, space=space, algorithm=algo,
+                         max_trials=10, pool_size=2).configure()
+        workon(
+            exp,
+            InProcessExecutor(lambda p: [{
+                "name": "o", "type": "objective",
+                "value": (p["x"] - 1) ** 2,
+            }]),
+            worker_id="w-liar",
+            producer_mode="coord",
+        )
+        assert ledger.count("liar-coord", "completed") == 10
+        with server._producers_guard:
+            prod, _plock = server._producers["liar-coord"]
+        assert prod.algorithm.supports_pending
+
+        # now make the pending set VISIBLE: hold a reservation from a
+        # second worker and drive one hosted produce cycle over RPC — the
+        # hosted algorithm must receive the in-flight trial as a lie row
+        from metaopt_tpu.worker.producer import RemoteProducer
+
+        exp.max_trials = 12  # reopen the budget so produce() suggests
+        ledger.update_experiment("liar-coord", {"max_trials": 12})
+        held = exp.reserve_trial("holder")
+        if held is None:  # everything completed: register one to hold
+            t = exp.make_trial({"x": 4.875})
+            exp.register_trials([t])
+            held = exp.reserve_trial("holder")
+        assert held is not None
+        RemoteProducer(exp, worker="w-liar").produce(pool_size=1)
+        with server._producers_guard:
+            prod, _plock = server._producers["liar-coord"]
+        assert prod.algorithm._pending_fp == (held.id,), \
+            "the hosted Producer must report reserved trials to the liar"
+        assert len(prod.algorithm._pending_X) == 1
+    finally:
+        server.stop()

@@ -1,0 +1,52 @@
+"""benchmarks/run.py: the relay_ok_after post-mortem on failed configs.
+
+The smoke record's judge-facing honesty hook: when a TPU-backed config
+dies (timeout or nonzero exit), the line records whether the relay
+still answered right after — an infrastructure flap reads differently
+from a code regression.
+"""
+
+import importlib.util
+import os
+import sys
+
+
+def load_run():
+    spec = importlib.util.spec_from_file_location(
+        "bench_run", os.path.join(os.path.dirname(__file__),
+                                  "..", "..", "benchmarks", "run.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_timeout_line_records_relay_state(tmp_path, monkeypatch):
+    run = load_run()
+    monkeypatch.setattr(run, "tpu_backend_reachable",
+                        lambda timeout_s=60.0: False)
+    spec = {
+        "cmd": [sys.executable, "-c", "import time; time.sleep(60)"],
+        "max_trials": {"smoke": 2}, "config": None,
+    }
+    out = run.run_config("annot", spec, "smoke", str(tmp_path),
+                         backend="tpu", config_timeout_s=3.0)
+    assert "error" in out and "timeout" in out["error"]
+    assert out["relay_ok_after"] is False
+
+
+def test_cpu_lines_skip_the_probe(tmp_path, monkeypatch):
+    run = load_run()
+
+    def boom(**_):
+        raise AssertionError("cpu runs must not probe the relay")
+
+    monkeypatch.setattr(run, "tpu_backend_reachable", boom)
+    spec = {
+        "cmd": [sys.executable, "-c", "import time; time.sleep(60)"],
+        "max_trials": {"smoke": 2}, "config": None,
+    }
+    out = run.run_config("annot2", spec, "smoke", str(tmp_path),
+                         backend="cpu", config_timeout_s=3.0)
+    assert "error" in out
+    assert "relay_ok_after" not in out
