@@ -181,6 +181,14 @@ class CoordLedgerClient(LedgerBackend):
         docs = self._call("fetch", experiment=experiment, status=status)
         return [Trial.from_dict(d) for d in docs]
 
+    def fetch_completed_since(self, experiment: str, cursor=None):
+        # decentralized-producer workers against a coordinator: the
+        # server's memory backend tracks completion order, so each cycle
+        # ships only the NEW completions over the wire
+        r = self._call("fetch_completed_since", experiment=experiment,
+                       cursor=cursor)
+        return [Trial.from_dict(d) for d in r["trials"]], r["cursor"]
+
     def release_stale(self, experiment: str, timeout_s: float) -> List[Trial]:
         # server-side so the sweep is atomic with every other mutation
         docs = self._call(

@@ -504,6 +504,12 @@ class CoordServer:
                 if isinstance(status, list):
                     status = tuple(status)
                 return [t.to_dict() for t in self.inner.fetch(a["experiment"], status)]
+            if op == "fetch_completed_since":
+                trials, cur = self.inner.fetch_completed_since(
+                    a["experiment"], a.get("cursor")
+                )
+                return {"trials": [t.to_dict() for t in trials],
+                        "cursor": cur}
             if op == "release_stale":
                 released = self.inner.release_stale(a["experiment"], a["timeout_s"])
                 return [t.to_dict() for t in released]

@@ -20,16 +20,21 @@ client (:mod:`metaopt_tpu.coord.client_backend`) registered under ``"coord"``.
 from __future__ import annotations
 
 import fcntl
+import itertools
 import json
 import os
 import threading
 import time
 import urllib.parse
+import uuid
 from abc import ABC, abstractmethod
 from typing import Any, Dict, List, Optional
 
 from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.utils.registry import Registry
+
+#: MemoryLedger instance counter (cursor epochs; see fetch_completed_since)
+_MEM_EPOCHS = itertools.count()
 
 ledger_registry: Registry = Registry("ledger backend")
 
@@ -98,6 +103,20 @@ class LedgerBackend(ABC):
     def count(self, experiment: str, status: Optional[str | tuple] = None) -> int:
         return len(self.fetch(experiment, status))
 
+    def fetch_completed_since(self, experiment: str, cursor=None):
+        """``(newly_completed_trials, next_cursor)`` — incremental observe.
+
+        The Producer's hot path: fetching EVERY completed trial each
+        produce cycle is O(n²) over an experiment's lifetime. Backends
+        that can track completion order return only the trials completed
+        since ``cursor`` plus an opaque next-cursor; this default returns
+        the full completed set with ``None`` (no incremental support —
+        correct, just slower). A backend may also invalidate cursors
+        (e.g. after compaction) by returning the full set again; callers
+        rely on the algorithms' observe-dedup for idempotence.
+        """
+        return self.fetch(experiment, "completed"), None
+
     def delete_experiment(self, name: str) -> bool:
         """Remove an experiment and its trials; False if unsupported.
 
@@ -141,6 +160,20 @@ class MemoryLedger(LedgerBackend):
         self._lock = threading.RLock()
         self._experiments: Dict[str, Dict[str, Any]] = {}
         self._trials: Dict[str, Dict[str, Trial]] = {}
+        #: per-experiment completion order (trial ids, appended on every
+        #: transition INTO completed) — backs fetch_completed_since
+        self._completed_log: Dict[str, List[str]] = {}
+        #: instance identity baked into cursors: a cursor minted against a
+        #: PREVIOUS instance (e.g. a restarted coordinator that restored a
+        #: snapshot in a different order) must trigger a full refetch, or
+        #: the holder silently skips completions it never saw. Random, not
+        #: pid+counter: a restarted container reuses pids and module
+        #: counters restart, which would alias the old incarnation exactly
+        self._epoch = uuid.uuid4().hex
+        #: per-experiment generation (bumped on create): a cursor from a
+        #: DELETED-and-recreated experiment must not alias the new history
+        #: once the new log catches up to the old cursor position
+        self._exp_gen: Dict[str, int] = {}
 
     def create_experiment(self, config: Dict[str, Any]) -> None:
         name = config["name"]
@@ -151,6 +184,8 @@ class MemoryLedger(LedgerBackend):
             # a fresh experiment must not inherit ghost trials left by a
             # register that raced a delete_experiment of the same name
             self._trials[name] = {}
+            self._completed_log[name] = []
+            self._exp_gen[name] = next(_MEM_EPOCHS)
 
     def load_experiment(self, name: str) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -172,6 +207,8 @@ class MemoryLedger(LedgerBackend):
             existed = name in self._experiments
             self._experiments.pop(name, None)
             self._trials.pop(name, None)
+            self._completed_log.pop(name, None)
+            self._exp_gen.pop(name, None)
             return existed
 
     def register(self, trial: Trial) -> None:
@@ -180,6 +217,10 @@ class MemoryLedger(LedgerBackend):
             if trial.id in exp:
                 raise DuplicateTrialError(trial.id)
             exp[trial.id] = Trial.from_dict(trial.to_dict())
+            if trial.status == "completed":  # db load of finished trials
+                self._completed_log.setdefault(
+                    trial.experiment, []
+                ).append(trial.id)
 
     def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
         with self._lock:
@@ -210,6 +251,10 @@ class MemoryLedger(LedgerBackend):
                 return False
             if expected_worker is not None and stored.worker != expected_worker:
                 return False
+            if trial.status == "completed" and stored.status != "completed":
+                self._completed_log.setdefault(
+                    trial.experiment, []
+                ).append(trial.id)
             exp[trial.id] = Trial.from_dict(trial.to_dict())
             return True
 
@@ -235,6 +280,37 @@ class MemoryLedger(LedgerBackend):
                     out.append(Trial.from_dict(t.to_dict()))
             out.sort(key=lambda t: (t.submit_time or 0, t.id))
             return out
+
+    def count(self, experiment: str, status=None) -> int:
+        # the base default is len(self.fetch(...)) — a full deep-copy
+        # deserialization of every trial just to count them, and is_done
+        # polls count() every workon cycle (O(n²) over an experiment)
+        statuses = (status,) if isinstance(status, str) else status
+        with self._lock:
+            ts = self._trials.get(experiment, {})
+            if statuses is None:
+                return len(ts)
+            return sum(1 for t in ts.values() if t.status in statuses)
+
+    def fetch_completed_since(self, experiment: str, cursor=None):
+        with self._lock:
+            log_ = self._completed_log.get(experiment, [])
+            gen = self._exp_gen.get(experiment, 0)
+            start = 0
+            if (cursor and cursor[0] == self._epoch
+                    and int(cursor[1]) == gen
+                    and int(cursor[2]) <= len(log_)):
+                start = int(cursor[2])
+            exp = self._trials.get(experiment, {})
+            out = [
+                Trial.from_dict(exp[tid].to_dict())
+                for tid in log_[start:]
+                # a revived (completed→new) trial stays in the log; skip
+                # it until it re-completes and re-appends
+                if tid in exp and exp[tid].status == "completed"
+            ]
+            out.sort(key=lambda t: (t.submit_time or 0, t.id))
+            return out, [self._epoch, gen, len(log_)]
 
 
 # ---------------------------------------------------------------------------

@@ -126,6 +126,10 @@ class Experiment:
     def fetch_trials(self, status=None) -> List[Trial]:
         return self.ledger.fetch(self.name, status)
 
+    def fetch_completed_since(self, cursor=None):
+        """(newly completed trials, next cursor) — the Producer hot path."""
+        return self.ledger.fetch_completed_since(self.name, cursor)
+
     def fetch_completed_trials(self) -> List[Trial]:
         return self.ledger.fetch(self.name, "completed")
 

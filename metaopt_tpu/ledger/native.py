@@ -208,6 +208,36 @@ class NativeFileLedger(FileLedger):
         with lk:
             return int(self._lib.ls_count(h, self._status_csv(status)))
 
+    def fetch_completed_since(self, experiment: str, cursor=None):
+        """Incremental completed-fetch off the engine's log clock.
+
+        The Producer calls this every produce cycle; the full ``fetch``
+        deserializes EVERY completed trial each time — O(n²) JSON decode
+        across an experiment (the 4096-trial sweep measured the
+        coordination plane dropping 296k→60k trials/hour from exactly
+        this). The engine's per-entry last-applied-record seq makes the
+        delta exact across processes; a compaction (new log inode = new
+        epoch) invalidates cursors and costs one full refetch, which the
+        algorithms' observe-dedup absorbs.
+        """
+        epoch, seq = cursor or (0, 0)
+        h, lk = self._handle(experiment)
+        with lk:
+            raw = self._take(self._lib.ls_fetch_since(
+                h, b"completed", int(epoch), int(seq)
+            ))
+        lines = raw.splitlines()
+        if not lines or not lines[0].startswith("C "):
+            return self.fetch(experiment, "completed"), None
+        _, new_epoch, new_seq = lines[0].split()
+        out = [
+            self._from_envelope(json.loads(line))
+            for line in lines[1:]
+            if line
+        ]
+        out.sort(key=lambda t: (t.submit_time or 0, t.id))
+        return out, (int(new_epoch), int(new_seq))
+
     def compact(self, experiment: str) -> int:
         """Rewrite the experiment's log to its live state; bytes reclaimed.
 

@@ -70,11 +70,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     )
     # char* returns are void_p so we can free them (c_char_p auto-converts
     # and leaks the buffer)
-    for fn in ("ls_reserve", "ls_get", "ls_fetch", "ls_release_stale"):
+    for fn in ("ls_reserve", "ls_get", "ls_fetch", "ls_fetch_since",
+               "ls_release_stale"):
         getattr(lib, fn).restype = ctypes.c_void_p
     lib.ls_reserve.argtypes = [ctypes.c_void_p, c_char_p]
     lib.ls_get.argtypes = [ctypes.c_void_p, c_char_p]
     lib.ls_fetch.argtypes = [ctypes.c_void_p, c_char_p]
+    lib.ls_fetch_since.argtypes = [
+        ctypes.c_void_p, c_char_p, ctypes.c_ulonglong, ctypes.c_ulonglong,
+    ]
     lib.ls_release_stale.argtypes = [ctypes.c_void_p, ctypes.c_double]
     lib.ls_heartbeat.restype = ctypes.c_int
     lib.ls_heartbeat.argtypes = [ctypes.c_void_p, c_char_p, c_char_p]

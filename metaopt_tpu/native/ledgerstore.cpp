@@ -45,6 +45,28 @@
 namespace {
 
 constexpr char kMagic[8] = {'M', 'T', 'P', 'U', 'L', 'D', 'G', '1'};
+// v2 header: magic + an 8-byte random epoch. The epoch identifies THIS
+// log file's history for fetch_since cursors — an inode number cannot
+// (inodes are recycled: a later compaction's tmp file can be allocated a
+// previously-freed inode, making a stale cursor read as current).
+constexpr char kMagic2[8] = {'M', 'T', 'P', 'U', 'L', 'D', 'G', '2'};
+
+uint64_t random_epoch() {
+  uint64_t e = 0;
+  int fd = ::open("/dev/urandom", O_RDONLY);
+  if (fd >= 0) {
+    if (::read(fd, &e, sizeof(e)) != sizeof(e)) e = 0;
+    ::close(fd);
+  }
+  if (e == 0) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    e = (static_cast<uint64_t>(ts.tv_sec) << 30) ^
+        static_cast<uint64_t>(ts.tv_nsec) ^
+        (static_cast<uint64_t>(getpid()) << 48);
+  }
+  return e ? e : 1;
+}
 
 double now_s() {
   struct timespec ts;
@@ -58,6 +80,7 @@ struct Entry {
   double heartbeat = 0.0;
   double order = 0.0;  // client-supplied sort key (submit time): FIFO reserve
   std::string payload;
+  uint64_t last_seq = 0;  // seq of the last applied record touching this key
 };
 
 struct Record {
@@ -74,16 +97,39 @@ class Store {
     log_fd_ = ::open((dir + "/trials.log").c_str(),
                      O_CREAT | O_RDWR | O_APPEND, 0666);
     if (lock_fd_ >= 0 && log_fd_ >= 0) {
-      // magic init under the lock: two processes first-opening the store
+      // header init under the lock: two processes first-opening the store
       // must not both append it (it would desync byte accounting)
       ::flock(lock_fd_, LOCK_EX);
-      struct stat st;
-      if (fstat(log_fd_, &st) == 0 && st.st_size == 0) {
-        ::write(log_fd_, kMagic, sizeof(kMagic));
-      }
+      read_or_init_header();
       ::flock(lock_fd_, LOCK_UN);
-      applied_ = sizeof(kMagic);
     }
+  }
+
+  // Reads the log header, initializing an empty file with the v2 header
+  // (magic + random epoch). v1 logs (magic only) fall back to the inode
+  // as epoch — imperfect (recyclable) but preserves old stores. Caller
+  // holds the lock. Sets epoch_ and applied_.
+  void read_or_init_header() {
+    struct stat st;
+    if (fstat(log_fd_, &st) != 0) return;
+    if (st.st_size == 0) {
+      epoch_ = random_epoch();
+      std::string hdr(kMagic2, sizeof(kMagic2));
+      hdr.append(reinterpret_cast<const char*>(&epoch_), sizeof(epoch_));
+      ::write(log_fd_, hdr.data(), hdr.size());
+      applied_ = hdr.size();
+      return;
+    }
+    char magic[8] = {0};
+    if (::pread(log_fd_, magic, sizeof(magic), 0) == sizeof(magic) &&
+        memcmp(magic, kMagic2, sizeof(kMagic2)) == 0 &&
+        ::pread(log_fd_, &epoch_, sizeof(epoch_), sizeof(magic)) ==
+            sizeof(epoch_)) {
+      applied_ = sizeof(kMagic2) + sizeof(epoch_);
+      return;
+    }
+    epoch_ = static_cast<uint64_t>(st.st_ino);  // legacy v1 log
+    applied_ = sizeof(kMagic);
   }
 
   ~Store() {
@@ -217,6 +263,35 @@ class Store {
     return out;
   }
 
+  // Incremental fetch: entries matching status whose last touching record
+  // was applied after `seq` of epoch `epoch`. Every process replaying the
+  // same log assigns identical seqs (a deterministic count of applied
+  // records), so a cursor handed out by one handle is valid in any other
+  // — until compaction replaces the log (new inode = new epoch), which
+  // invalidates cursors and forces one full refetch. First line of the
+  // result: "C <epoch> <max_seq>"; envelopes follow.
+  std::string fetch_since(const char* status_csv, uint64_t epoch,
+                          uint64_t seq) {
+    Guard g(this);
+    uint64_t cur_epoch = epoch_;
+    if (epoch != cur_epoch) seq = 0;  // stale cursor: full scan once
+    std::vector<std::string> wanted = split_csv(status_csv);
+    char head[64];
+    snprintf(head, sizeof(head), "C %llu %llu\n",
+             static_cast<unsigned long long>(cur_epoch),
+             static_cast<unsigned long long>(seq_));
+    std::string out = head;
+    for (const auto& key : order_) {
+      auto it = index_.find(key);
+      if (it == index_.end()) continue;
+      if (it->second.last_seq <= seq) continue;
+      if (!wanted.empty() && !contains(wanted, it->second.status)) continue;
+      out += envelope(key, it->second);
+      out += '\n';
+    }
+    return out;
+  }
+
   long count(const char* status_csv) {
     Guard g(this);
     std::vector<std::string> wanted = split_csv(status_csv);
@@ -242,7 +317,12 @@ class Store {
     int tmp_fd = ::open(tmp_path.c_str(),
                         O_CREAT | O_TRUNC | O_WRONLY, 0666);
     if (tmp_fd < 0) return -1;
-    std::string out(kMagic, sizeof(kMagic));
+    // the rewritten log is a NEW history: fresh epoch so every held
+    // fetch_since cursor invalidates (forcing one safe full refetch)
+    const uint64_t new_epoch = random_epoch();
+    std::string out(kMagic2, sizeof(kMagic2));
+    out.append(reinterpret_cast<const char*>(&new_epoch),
+               sizeof(new_epoch));
     for (const auto& key : order_) {
       auto it = index_.find(key);
       if (it == index_.end()) continue;
@@ -269,6 +349,17 @@ class Store {
                      O_CREAT | O_RDWR | O_APPEND, 0666);
     if (log_fd_ < 0) return -1;
     applied_ = out.size();
+    epoch_ = new_epoch;
+    // re-derive seqs as a FRESH replayer of the rewritten log would
+    // (two records per live key, in order_ order) — cursor consistency
+    // across processes depends on every handle agreeing on (epoch, seq)
+    seq_ = 0;
+    for (const auto& key : order_) {
+      auto it = index_.find(key);
+      if (it == index_.end()) continue;
+      seq_ += 2;
+      it->second.last_seq = seq_;
+    }
     // a log of pure put records can legally GROW slightly (two records per
     // key after compaction): that is still success, not an IO failure —
     // report zero reclaimed rather than a negative the caller would treat
@@ -361,19 +452,25 @@ class Store {
                      O_CREAT | O_RDWR | O_APPEND, 0666);
     index_.clear();
     order_.clear();
-    applied_ = sizeof(kMagic);
+    seq_ = 0;  // fresh log = fresh epoch: seqs restart with the replay
+    read_or_init_header();
   }
 
   void apply(const Record& r) {
+    // every applied record advances the log clock — deterministic across
+    // processes because all replay the identical record stream
+    ++seq_;
     if (r.op == 1) {
       if (index_.count(r.key)) return;  // insert-only
-      index_[r.key] = Entry{r.status, r.worker, 0.0, r.heartbeat, r.payload};
+      index_[r.key] =
+          Entry{r.status, r.worker, 0.0, r.heartbeat, r.payload, seq_};
       order_.push_back(r.key);
       return;
     }
     auto it = index_.find(r.key);
     if (it == index_.end()) return;  // mark/beat for unknown key: ignore
     Entry& e = it->second;
+    e.last_seq = seq_;
     if (r.op == 2) {
       e.status = r.status;
       e.worker = r.worker;
@@ -455,6 +552,8 @@ class Store {
   int lock_fd_ = -1;
   int log_fd_ = -1;
   size_t applied_ = 0;  // log bytes reflected in the index
+  uint64_t seq_ = 0;    // applied-record count: the log's logical clock
+  uint64_t epoch_ = 0;  // this log file's identity (fetch_since cursors)
   std::unordered_map<std::string, Entry> index_;
   std::vector<std::string> order_;  // insertion order, for FIFO reserve
 };
@@ -511,6 +610,12 @@ char* ls_get(void* h, const char* key) {
 
 char* ls_fetch(void* h, const char* status_csv) {
   return dup_or_null(static_cast<Store*>(h)->fetch(status_csv));
+}
+
+char* ls_fetch_since(void* h, const char* status_csv,
+                     unsigned long long epoch, unsigned long long seq) {
+  return dup_or_null(
+      static_cast<Store*>(h)->fetch_since(status_csv, epoch, seq));
 }
 
 long ls_count(void* h, const char* status_csv) {

@@ -28,6 +28,10 @@ class Producer:
         #: mirrored by RemoteProducer so workon need not touch the algorithm
         self.algo_done = False
         self._warm_started = False
+        #: incremental-observe cursor (fetch_completed_since); None both
+        #: before the first cycle and on backends without incremental
+        #: support (their default returns the full set with cursor=None)
+        self._completed_cursor = None
 
     def produce(self, pool_size: Optional[int] = None) -> int:
         """One observe→suggest→register cycle; returns #trials registered."""
@@ -58,7 +62,16 @@ class Producer:
                     "warm start: observed %d/%d completed trials from %r",
                     len(usable), len(fetched), src,
                 )
-        self.algorithm.observe(exp.fetch_completed_trials())
+        # incremental observe: only the trials completed since the last
+        # cycle (re-fetching the whole completed set every cycle is O(n²)
+        # JSON decode over an experiment — the 4096-trial sweep measured
+        # the coordination plane at 1/5th throughput from exactly that).
+        # Cursor invalidation (backend compaction, restart) degrades to a
+        # full fetch, which observe's per-id dedup absorbs.
+        new_done, self._completed_cursor = exp.fetch_completed_since(
+            self._completed_cursor
+        )
+        self.algorithm.observe(new_done)
         if getattr(self.algorithm, "supports_pending", False):
             # parallel strategy (lineage "liar"): in-flight trials join
             # the fit with a lie objective so N racing workers don't pile
