@@ -181,12 +181,35 @@ class CoordLedgerClient(LedgerBackend):
         docs = self._call("fetch", experiment=experiment, status=status)
         return [Trial.from_dict(d) for d in docs]
 
+    def count(self, experiment: str, status=None) -> int:
+        # server-side: the base default is len(self.fetch(...)), which
+        # RPCs and deserializes EVERY trial document — and the workon
+        # loop counts twice per cycle (is_done + the producer's budget)
+        if isinstance(status, tuple):
+            status = list(status)
+        try:
+            return int(self._call("count", experiment=experiment,
+                                  status=status))
+        except CoordRPCError as err:
+            if "unknown op" not in str(err):
+                raise
+            # rolling upgrade: a pre-count coordinator — degrade to the
+            # base fetch-and-len path rather than killing the workon loop
+            return len(self.fetch(experiment, tuple(status)
+                                  if isinstance(status, list) else status))
+
     def fetch_completed_since(self, experiment: str, cursor=None):
         # decentralized-producer workers against a coordinator: the
         # server's memory backend tracks completion order, so each cycle
         # ships only the NEW completions over the wire
-        r = self._call("fetch_completed_since", experiment=experiment,
-                       cursor=cursor)
+        try:
+            r = self._call("fetch_completed_since", experiment=experiment,
+                           cursor=cursor)
+        except CoordRPCError as err:
+            if "unknown op" not in str(err):
+                raise
+            # pre-cursor coordinator: full fetch, no incremental support
+            return self.fetch(experiment, "completed"), None
         return [Trial.from_dict(d) for d in r["trials"]], r["cursor"]
 
     def release_stale(self, experiment: str, timeout_s: float) -> List[Trial]:

@@ -226,7 +226,7 @@ class CoordServer:
                         for name in self.inner.list_experiments()
                     },
                     "trials": {
-                        name: [t.to_dict() for t in self.inner.fetch(name)]
+                        name: self.inner.export_docs(name)
                         for name in self.inner.list_experiments()
                     },
                     "signals": [
@@ -504,6 +504,11 @@ class CoordServer:
                 if isinstance(status, list):
                     status = tuple(status)
                 return [t.to_dict() for t in self.inner.fetch(a["experiment"], status)]
+            if op == "count":
+                status = a.get("status")
+                if isinstance(status, list):
+                    status = tuple(status)
+                return self.inner.count(a["experiment"], status)
             if op == "fetch_completed_since":
                 trials, cur = self.inner.fetch_completed_since(
                     a["experiment"], a.get("cursor")

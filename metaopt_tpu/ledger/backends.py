@@ -117,6 +117,15 @@ class LedgerBackend(ABC):
         """
         return self.fetch(experiment, "completed"), None
 
+    def export_docs(self, experiment: str) -> List[Dict[str, Any]]:
+        """Raw trial documents — the snapshot/archive path.
+
+        Backends that store documents directly override this to skip the
+        Trial round-trip (MemoryLedger does one conversion instead of
+        three); the default is correct everywhere.
+        """
+        return [t.to_dict() for t in self.fetch(experiment)]
+
     def delete_experiment(self, name: str) -> bool:
         """Remove an experiment and its trials; False if unsupported.
 
@@ -291,6 +300,17 @@ class MemoryLedger(LedgerBackend):
             if statuses is None:
                 return len(ts)
             return sum(1 for t in ts.values() if t.status in statuses)
+
+    def export_docs(self, experiment: str) -> List[Dict[str, Any]]:
+        """Raw trial documents, one conversion each — the snapshot path.
+
+        ``fetch`` deep-copies through from_dict(to_dict(...)) and the
+        snapshot then calls to_dict again: three conversions per trial
+        under the coordinator's global lock. This does one.
+        """
+        with self._lock:
+            return [t.to_dict() for t in
+                    self._trials.get(experiment, {}).values()]
 
     def fetch_completed_since(self, experiment: str, cursor=None):
         with self._lock:

@@ -220,11 +220,18 @@ class NativeFileLedger(FileLedger):
         epoch) invalidates cursors and costs one full refetch, which the
         algorithms' observe-dedup absorbs.
         """
-        epoch, seq = cursor or (0, 0)
+        try:
+            epoch, seq = cursor or (0, 0)
+            epoch, seq = int(epoch), int(seq)
+        except (TypeError, ValueError):
+            # a foreign-shape cursor (another backend's, a stale persisted
+            # one) must DEGRADE to a full refetch, never kill the produce
+            # cycle — the base-class contract
+            epoch, seq = 0, 0
         h, lk = self._handle(experiment)
         with lk:
             raw = self._take(self._lib.ls_fetch_since(
-                h, b"completed", int(epoch), int(seq)
+                h, b"completed", epoch, seq
             ))
         lines = raw.splitlines()
         if not lines or not lines[0].startswith("C "):

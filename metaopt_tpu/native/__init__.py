@@ -107,6 +107,19 @@ def load_ledgerstore():
                 return None
         try:
             _lib = _bind(ctypes.CDLL(_SO))
+        except AttributeError as e:
+            # a stale cached .so missing a newer symbol (package upgrades
+            # can unpack a SOURCE mtime older than a leftover build):
+            # force one rebuild, then give up gracefully
+            log.warning("ledgerstore symbols stale (%s); rebuilding", e)
+            if _compile():
+                try:
+                    _lib = _bind(ctypes.CDLL(_SO))
+                except (OSError, AttributeError) as e2:
+                    log.warning("ledgerstore reload failed: %s", e2)
+                    _load_failed = True
+            else:
+                _load_failed = True
         except OSError as e:
             log.warning("ledgerstore load failed: %s", e)
             _load_failed = True

@@ -128,8 +128,19 @@ class Store {
       applied_ = sizeof(kMagic2) + sizeof(epoch_);
       return;
     }
-    epoch_ = static_cast<uint64_t>(st.st_ino);  // legacy v1 log
-    applied_ = sizeof(kMagic);
+    if (memcmp(magic, kMagic, sizeof(kMagic)) == 0) {  // legacy v1 log
+      epoch_ = static_cast<uint64_t>(st.st_ino);
+      applied_ = sizeof(kMagic);
+      return;
+    }
+    // UNKNOWN format (a future version, or not our file): never parse,
+    // never truncate, and never APPEND — mixing v2 records into a log
+    // this build does not understand corrupts it for the build that
+    // does. The store becomes read-only-empty: reads see nothing,
+    // writes fail loudly.
+    foreign_ = true;
+    epoch_ = static_cast<uint64_t>(st.st_ino);
+    applied_ = static_cast<size_t>(st.st_size);
   }
 
   ~Store() {
@@ -430,6 +441,7 @@ class Store {
   }
 
   bool append(const Record& r) {
+    if (foreign_) return false;  // never write into an unknown format
     std::string rec;
     append_record(rec, r);
     ssize_t n = ::write(log_fd_, rec.data(), rec.size());
@@ -453,6 +465,7 @@ class Store {
     index_.clear();
     order_.clear();
     seq_ = 0;  // fresh log = fresh epoch: seqs restart with the replay
+    foreign_ = false;  // the replacement may be OURS again
     read_or_init_header();
   }
 
@@ -488,6 +501,7 @@ class Store {
   // Replay records other processes appended since our last look. Truncates
   // a torn tail (crash mid-write) so the log stays parseable.
   void replay_tail() {
+    if (foreign_) return;  // never parse (or "repair") an unknown format
     struct stat st;
     if (fstat(log_fd_, &st) != 0) return;
     if (static_cast<off_t>(applied_) >= st.st_size) return;
@@ -501,6 +515,13 @@ class Store {
     while (pos + 4 <= buf.size()) {
       uint32_t body_len;
       memcpy(&body_len, buf.data() + pos, 4);
+      if (body_len > (64u << 20)) {
+        // no legal record is 64MB: this is NOT a torn tail but bytes in
+        // a format we don't understand (e.g. a newer log header read by
+        // an older build) — truncating would destroy the store. Stop
+        // parsing and leave the file alone.
+        break;
+      }
       if (pos + 4 + body_len > buf.size()) {
         // torn tail — drop it (holder of the exclusive lock may truncate)
         if (::ftruncate(log_fd_, applied_ + pos) == 0) {
@@ -554,6 +575,7 @@ class Store {
   size_t applied_ = 0;  // log bytes reflected in the index
   uint64_t seq_ = 0;    // applied-record count: the log's logical clock
   uint64_t epoch_ = 0;  // this log file's identity (fetch_since cursors)
+  bool foreign_ = false;  // log format unknown: read-as-empty, no writes
   std::unordered_map<std::string, Entry> index_;
   std::vector<std::string> order_;  // insertion order, for FIFO reserve
 };

@@ -68,10 +68,14 @@ class Producer:
         # the coordination plane at 1/5th throughput from exactly that).
         # Cursor invalidation (backend compaction, restart) degrades to a
         # full fetch, which observe's per-id dedup absorbs.
-        new_done, self._completed_cursor = exp.fetch_completed_since(
+        new_done, next_cursor = exp.fetch_completed_since(
             self._completed_cursor
         )
         self.algorithm.observe(new_done)
+        # commit the cursor ONLY after observe succeeded: a raise above
+        # (hosted producers survive it and are retried) must re-fetch the
+        # same delta next cycle, not drop it from the surrogate forever
+        self._completed_cursor = next_cursor
         if getattr(self.algorithm, "supports_pending", False):
             # parallel strategy (lineage "liar"): in-flight trials join
             # the fit with a lie objective so N racing workers don't pile

@@ -211,3 +211,64 @@ class TestCursorAliasing:
         ledger.compact("inc")
         _, cur3 = ledger.fetch_completed_since("inc", cur2)
         assert cur3[0] not in (cur1[0], cur2[0])
+
+
+class TestRobustness:
+    def test_native_foreign_cursor_degrades_to_full(self, tmp_path):
+        try:
+            ledger = make_ledger({"type": "native", "path": str(tmp_path)})
+        except RuntimeError:
+            pytest.skip("no native toolchain")
+        seed_experiment(ledger, n=2)
+        # a MEMORY-shaped cursor (3 elements, hex epoch) must not raise
+        trials, cur = ledger.fetch_completed_since(
+            "inc", ["deadbeef", 3, 7]
+        )
+        assert len(trials) == 2
+        again, _ = ledger.fetch_completed_since("inc", cur)
+        assert again == []
+
+    def test_unknown_log_format_never_truncated(self, tmp_path):
+        """A log in a format this build does not understand (e.g. a future
+        version) must be left byte-for-byte intact — reading it as empty
+        is safe, 'repairing' it is data loss."""
+        try:
+            make_ledger({"type": "native", "path": str(tmp_path)})
+        except RuntimeError:
+            pytest.skip("no native toolchain")
+        import os
+
+        store = tmp_path / "x" / "store"
+        os.makedirs(store)
+        blob = b"MTPULDG9" + os.urandom(64)  # future-format stand-in
+        with open(store / "trials.log", "wb") as f:
+            f.write(blob)
+        ledger = make_ledger({"type": "native", "path": str(tmp_path)})
+        ledger.create_experiment({
+            "name": "x", "space": {"x": "uniform(0, 1)"},
+            "algorithm": {"random": {}}, "max_trials": 5, "version": 1,
+        })
+        assert ledger.fetch("x") == []          # reads empty, no crash
+        # and WRITES are refused: appending v2 records into a foreign
+        # format would corrupt it for the build that owns it
+        with pytest.raises(Exception):
+            ledger.register(Trial(params={"x": 0.5}, experiment="x"))
+        with open(store / "trials.log", "rb") as f:
+            content = f.read()
+        assert content == blob  # byte-for-byte intact: no truncate, no append
+
+    def test_coord_count_is_served_remotely(self):
+        from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+
+        server = CoordServer().start()
+        host, port = server.address
+        try:
+            ledger = CoordLedgerClient(host=host, port=port)
+            seed_experiment(ledger, n=3)
+            t = Trial(params={"x": 0.9}, experiment="inc")
+            ledger.register(t)
+            assert ledger.count("inc") == 4
+            assert ledger.count("inc", "completed") == 3
+            assert ledger.count("inc", ("new", "reserved")) == 1
+        finally:
+            server.stop()
