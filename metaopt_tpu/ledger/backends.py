@@ -351,6 +351,11 @@ class FileLedger(LedgerBackend):
     def __init__(self, path: Optional[str] = None, **_: Any) -> None:
         self.root = path or os.path.expanduser("~/.metaopt_tpu/ledger")
         os.makedirs(self.root, exist_ok=True)
+        #: per-experiment parsed-index cache keyed by the index file's
+        #: (mtime_ns, size): another process's write changes the key and
+        #: forces a re-read; our own writes refresh it. Purely an
+        #: in-process read-amplification fix — the flock still serializes
+        self._idx_cache: Dict[str, tuple] = {}
 
     # -- internals --------------------------------------------------------
     def _edir(self, name: str) -> str:
@@ -392,6 +397,12 @@ class FileLedger(LedgerBackend):
             with open(path) as f:
                 return json.load(f)
         except FileNotFoundError:
+            return None
+        except json.JSONDecodeError:
+            # a crash can leave an empty/truncated file even with the
+            # tmp+rename write (rename without fsync): treat as missing so
+            # the callers' heal paths (index rebuild, doc skip) engage
+            # instead of wedging every subsequent op on the experiment
             return None
 
     def _tpath(self, experiment: str, trial_id: str) -> str:
@@ -446,9 +457,115 @@ class FileLedger(LedgerBackend):
             # removing the dir cannot fork the lock identity under a
             # blocked waiter; only the (tiny, reusable) lock file persists
             shutil.rmtree(self._edir(name), ignore_errors=True)
+            self._idx_cache.pop(name, None)
         return True
 
     # -- trials -----------------------------------------------------------
+    # -- trial status index ------------------------------------------------
+    # <edir>/trials.index.json: {"epoch", "statuses": {id: status},
+    # "completed_log": [ids in completion order]} — maintained inside the
+    # SAME flock critical sections that write trial docs, so count() and
+    # fetch_completed_since() stop reading every document per call (the
+    # workon loop counts twice per cycle: O(n²) JSON reads over an
+    # experiment). Self-healing: a missing/corrupt index, or a file count
+    # that disagrees with the directory (a writer from before the index
+    # existed), triggers a full rebuild under a fresh epoch. As with the
+    # lock-path change, a fleet SHARING one file ledger must upgrade
+    # together (MIGRATION.md) — an old writer flips statuses without
+    # touching the index, which the file-count check cannot see.
+
+    def _ipath(self, experiment: str) -> str:
+        return os.path.join(self._edir(experiment), "trials.index.json")
+
+    def _tdir(self, experiment: str) -> str:
+        return os.path.join(self._edir(experiment), "trials")
+
+    def _rebuild_index(self, experiment: str) -> Dict[str, Any]:
+        """Full scan → fresh index (fresh epoch: held cursors invalidate)."""
+        tdir = self._tdir(experiment)
+        statuses: Dict[str, str] = {}
+        done: List[tuple] = []
+        if os.path.isdir(tdir):
+            for fn in os.listdir(tdir):
+                if not fn.endswith(".json"):
+                    continue
+                doc = self._read_json(os.path.join(tdir, fn))
+                if not doc:
+                    continue
+                statuses[doc["id"]] = doc.get("status", "new")
+                if doc.get("status") == "completed":
+                    done.append((doc.get("end_time") or 0, doc["id"]))
+        idx = {
+            "epoch": uuid.uuid4().hex,
+            "statuses": statuses,
+            "completed_log": [tid for _, tid in sorted(done)],
+        }
+        self._write_json(self._ipath(experiment), idx)
+        return idx
+
+    def _index_stamp(self, experiment: str):
+        try:
+            st = os.stat(self._ipath(experiment))
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def _load_index(self, experiment: str,
+                    heal: bool = True) -> Dict[str, Any]:
+        """The index, rebuilt when missing or visibly out of sync.
+
+        The sync check (``heal=True``, the READ paths) is a listdir
+        LENGTH comparison — no document reads — catching registrations
+        that bypassed the index. The WRITE path (:meth:`_index_set`)
+        passes ``heal=False``: it runs right after this process's own
+        document write, where a one-file delta is the expected state,
+        not drift — healing there would mint a fresh epoch (cursor
+        invalidation = full refetch) on every single register. A cached
+        parse is reused while the index file's stamp is unchanged.
+        """
+        stamp = self._index_stamp(experiment)
+        cached = self._idx_cache.get(experiment)
+        if cached is not None and stamp is not None and cached[0] == stamp:
+            idx = cached[1]
+        else:
+            idx = self._read_json(self._ipath(experiment))
+        broken = (not isinstance(idx, dict) or "statuses" not in idx
+                  or "completed_log" not in idx)
+        if not broken and heal:
+            tdir = self._tdir(experiment)
+            n_files = (
+                sum(1 for fn in os.listdir(tdir) if fn.endswith(".json"))
+                if os.path.isdir(tdir) else 0
+            )
+            broken = len(idx["statuses"]) != n_files
+        if broken:
+            idx = self._rebuild_index(experiment)
+            stamp = self._index_stamp(experiment)
+        self._idx_cache[experiment] = (stamp, idx)
+        return idx
+
+    def _index_set(self, experiment: str, trial_id: str,
+                   status: str) -> None:
+        idx = self._load_index(experiment, heal=False)
+        old = idx["statuses"].get(trial_id)
+        idx["statuses"][trial_id] = status
+        if status == "completed" and old != "completed":
+            idx["completed_log"].append(trial_id)
+        try:
+            self._write_json(self._ipath(experiment), idx)
+        except OSError:
+            # the trial DOC already committed; a stale on-disk index with
+            # an unchanged file count would evade the listdir heal and
+            # (for a final completion) never self-correct — drop the
+            # index so the next read rebuilds from the documents
+            self._idx_cache.pop(experiment, None)
+            try:
+                os.remove(self._ipath(experiment))
+            except OSError:
+                pass
+            return
+        self._idx_cache[experiment] = (self._index_stamp(experiment), idx)
+
     def register(self, trial: Trial) -> None:
         with self._locked(trial.experiment):
             path = self._tpath(trial.experiment, trial.id)
@@ -456,18 +573,23 @@ class FileLedger(LedgerBackend):
                 raise DuplicateTrialError(trial.id)
             os.makedirs(os.path.dirname(path), exist_ok=True)
             self._write_json(path, trial.to_dict())
+            self._index_set(trial.experiment, trial.id, trial.status)
 
     def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
         with self._locked(experiment):
-            tdir = os.path.join(self._edir(experiment), "trials")
+            tdir = self._tdir(experiment)
             if not os.path.isdir(tdir):
                 return None
+            # the index narrows the candidate READS to 'new' trials; the
+            # documents themselves stay the authority (re-checked below)
+            idx = self._load_index(experiment)
             docs = []
-            for fn in os.listdir(tdir):
-                if fn.endswith(".json"):
-                    doc = self._read_json(os.path.join(tdir, fn))
-                    if doc and doc.get("status") == "new":
-                        docs.append(doc)
+            for tid, st in idx["statuses"].items():
+                if st != "new":
+                    continue
+                doc = self._read_json(self._tpath(experiment, tid))
+                if doc and doc.get("status") == "new":
+                    docs.append(doc)
             if not docs:
                 return None
             docs.sort(key=lambda d: (d.get("submit_time") or 0, d["id"]))
@@ -475,6 +597,7 @@ class FileLedger(LedgerBackend):
             t.transition("reserved")
             t.worker = worker
             self._write_json(self._tpath(experiment, t.id), t.to_dict())
+            self._index_set(experiment, t.id, "reserved")
             return t
 
     def update_trial(
@@ -493,7 +616,39 @@ class FileLedger(LedgerBackend):
             if expected_worker is not None and stored.get("worker") != expected_worker:
                 return False
             self._write_json(path, trial.to_dict())
+            self._index_set(trial.experiment, trial.id, trial.status)
             return True
+
+    def count(self, experiment: str, status=None) -> int:
+        statuses = (status,) if isinstance(status, str) else status
+        with self._locked(experiment):
+            if not os.path.isdir(self._edir(experiment)):
+                return 0
+            vals = self._load_index(experiment)["statuses"].values()
+            if statuses is None:
+                return len(vals)
+            return sum(1 for v in vals if v in statuses)
+
+    def fetch_completed_since(self, experiment: str, cursor=None):
+        with self._locked(experiment):
+            if not os.path.isdir(self._edir(experiment)):
+                return [], None
+            idx = self._load_index(experiment)
+            log_ = idx["completed_log"]
+            start = 0
+            try:
+                if cursor and cursor[0] == idx["epoch"] \
+                        and int(cursor[1]) <= len(log_):
+                    start = int(cursor[1])
+            except (TypeError, ValueError, KeyError, IndexError):
+                start = 0  # foreign cursor shape: full refetch
+            out = []
+            for tid in log_[start:]:
+                doc = self._read_json(self._tpath(experiment, tid))
+                if doc and doc.get("status") == "completed":
+                    out.append(Trial.from_dict(doc))
+            out.sort(key=lambda t: (t.submit_time or 0, t.id))
+            return out, [idx["epoch"], len(log_)]
 
     def heartbeat(self, experiment: str, trial_id: str, worker: str) -> bool:
         with self._locked(experiment):
@@ -513,7 +668,7 @@ class FileLedger(LedgerBackend):
     def fetch(self, experiment: str, status=None) -> List[Trial]:
         statuses = (status,) if isinstance(status, str) else status
         with self._locked(experiment):
-            tdir = os.path.join(self._edir(experiment), "trials")
+            tdir = self._tdir(experiment)
             out = []
             if os.path.isdir(tdir):
                 for fn in os.listdir(tdir):

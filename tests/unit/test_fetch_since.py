@@ -86,14 +86,39 @@ class TestMemoryIncremental:
         assert ids == [t.id]
 
 
-class TestFileFallback:
-    def test_full_fetch_with_none_cursor(self, tmp_path):
+class TestFileIncremental:
+    def test_delta_between_cursors(self, tmp_path):
         ledger = FileLedger(str(tmp_path))
         seed_experiment(ledger, n=2)
         trials, cur = ledger.fetch_completed_since("inc", None)
-        assert len(trials) == 2 and cur is None
-        trials, cur = ledger.fetch_completed_since("inc", cur)
-        assert len(trials) == 2  # no incremental support: full each time
+        assert len(trials) == 2
+        again, cur2 = ledger.fetch_completed_since("inc", cur)
+        assert again == []
+        complete_one(ledger, "inc", 9)
+        new, _ = ledger.fetch_completed_since("inc", cur2)
+        assert len(new) == 1 and new[0].objective == 9.0
+
+    def test_index_self_heals_after_unindexed_writes(self, tmp_path):
+        import json as _json
+        import os as _os
+
+        ledger = FileLedger(str(tmp_path))
+        seed_experiment(ledger, n=2)
+        _, cur = ledger.fetch_completed_since("inc", None)
+        # simulate a pre-index writer: drop a completed trial doc into
+        # the directory without touching the index
+        tdir = ledger._tdir("inc")
+        doc = _json.loads(open(_os.path.join(
+            tdir, sorted(_os.listdir(tdir))[0])).read())
+        doc["id"] = "feedfeedfeedfeedfeedfeed"
+        doc["params"] = {"x": 0.777}
+        with open(_os.path.join(tdir, doc["id"] + ".json"), "w") as f:
+            _json.dump(doc, f)
+        # the file-count check trips a rebuild; the fresh epoch forces a
+        # full refetch that INCLUDES the foreign doc
+        new, _ = ledger.fetch_completed_since("inc", cur)
+        assert any(t.id == doc["id"] for t in new)
+        assert ledger.count("inc", "completed") == 3
 
 
 class TestNativeIncremental:
@@ -272,3 +297,22 @@ class TestRobustness:
             assert ledger.count("inc", ("new", "reserved")) == 1
         finally:
             server.stop()
+
+    def test_corrupt_index_self_heals(self, tmp_path):
+        ledger = FileLedger(str(tmp_path))
+        seed_experiment(ledger, n=2)
+        ledger.fetch_completed_since("inc", None)
+        # crash artifact: an empty index file
+        with open(ledger._ipath("inc"), "w") as f:
+            f.write("")
+        ledger._idx_cache.clear()
+        assert ledger.count("inc", "completed") == 2  # rebuilt, not crashed
+        trials, _ = ledger.fetch_completed_since("inc", None)
+        assert len(trials) == 2
+
+    def test_dict_and_short_cursors_degrade(self, tmp_path):
+        ledger = FileLedger(str(tmp_path))
+        seed_experiment(ledger, n=2)
+        for weird in ({"epoch": "x"}, ["onlyepoch"], 7):
+            trials, _ = ledger.fetch_completed_since("inc", weird)
+            assert len(trials) == 2, weird
