@@ -1,0 +1,89 @@
+"""Cross-process incremental-observe: cursors must never skip completions.
+
+One OS process completes trials against a shared on-disk ledger while
+this process walks its ``fetch_completed_since`` cursor concurrently —
+the union of deltas must equal every completion, exactly once per id,
+regardless of interleaving. This is the invariant the Producer's
+surrogate quality rides on.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from metaopt_tpu.ledger.backends import make_ledger
+
+N = 40
+
+_WRITER = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from metaopt_tpu.ledger.backends import make_ledger
+from metaopt_tpu.ledger.trial import Trial
+
+ledger = make_ledger({spec!r})
+for i in range({n}):
+    t = Trial(params={{"x": i / 1000.0}}, experiment="race")
+    ledger.register(t)
+    got = ledger.reserve("race", "writer")
+    got.attach_results(
+        [{{"name": "o", "type": "objective", "value": float(i)}}]
+    )
+    got.transition("completed")
+    assert ledger.update_trial(got, expected_status="reserved")
+    if i % 7 == 0:
+        time.sleep(0.01)  # vary the interleaving
+print("writer done", flush=True)
+"""
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _run_race(spec):
+    ledger = make_ledger(spec)
+    ledger.create_experiment({
+        "name": "race", "space": {"x": "uniform(0, 1)"},
+        "algorithm": {"random": {}}, "max_trials": N + 1, "version": 1,
+    })
+    code = _WRITER.format(repo=REPO, spec=spec, n=N)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    seen = []
+    cursor = None
+    deadline = time.time() + 120
+    try:
+        while time.time() < deadline:
+            new, cursor = ledger.fetch_completed_since("race", cursor)
+            seen.extend(t.id for t in new)
+            if proc.poll() is not None:
+                # writer exited (success OR crash): one drain pass, then
+                # stop — waiting out the deadline on a crashed writer
+                # would stall the failure report by two minutes
+                tail, cursor = ledger.fetch_completed_since("race", cursor)
+                seen.extend(t.id for t in tail)
+                break
+            time.sleep(0.005)
+    finally:
+        proc.kill()
+        proc.wait()
+    assert proc.returncode == 0
+    assert len(seen) == N, f"saw {len(seen)} of {N} completions"
+    assert len(set(seen)) == N, "a delta repeated a completion"
+
+
+def test_file_backend_cursor_sees_every_completion(tmp_path):
+    _run_race({"type": "file", "path": str(tmp_path)})
+
+
+def test_native_backend_cursor_sees_every_completion(tmp_path):
+    try:
+        make_ledger({"type": "native", "path": str(tmp_path)})
+    except RuntimeError:
+        pytest.skip("no native toolchain")
+    _run_race({"type": "native", "path": str(tmp_path)})
