@@ -1,0 +1,133 @@
+"""Driver-entry hardening: dryrun_multichip must survive any driver env.
+
+MULTICHIP r1-r3 all went red because the dryrun parent initialized a jax
+backend in-process and hung on a wedged axon relay (rc=124 at the driver's
+deadline). The contract under test: the PARENT process of
+``dryrun_multichip`` never imports jax at all — routing to the scrubbed CPU
+child is decided from env + sys.modules only — so no relay state can wedge
+it. SURVEY.md §7 steps 6-7 (the driver's multi-chip gate).
+
+These tests poison ``import jax`` in a subprocess (a PYTHONPATH shim that
+raises) and run the parent in plan-only mode under the hostile env shapes
+that killed previous rounds. If any parent code path imports jax, the child
+exits non-zero with the poison marker in its output.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POISON = "POISONED-JAX-IMPORTED-IN-PARENT"
+
+
+def _run_parent(tmp_path, extra_env, n_devices=8, timeout_s=60.0):
+    """Run dryrun_multichip(n) in a subprocess with poisoned jax import."""
+    shim = tmp_path / "shim"
+    shim.mkdir(exist_ok=True)
+    (shim / "jax.py").write_text(
+        f"raise RuntimeError({POISON!r})\n"
+    )
+    env = dict(os.environ)
+    # scrub everything the conftest set, then apply the hostile shape
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("_METAOPT_TPU_DRYRUN_CHILD", None)
+    env["PYTHONPATH"] = str(shim) + os.pathsep + REPO
+    env["_METAOPT_TPU_DRYRUN_PLAN_ONLY"] = "1"
+    env.update(extra_env)
+    code = textwrap.dedent(
+        f"""
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip({n_devices})
+        print("PARENT-DONE")
+        """
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, timeout=timeout_s,
+        capture_output=True, text=True,
+    )
+    return proc
+
+
+@pytest.mark.parametrize(
+    "hostile_env",
+    [
+        # r3's driver shape: axon platform active, no POOL_IPS set — the
+        # exact fall-through that reached in-process jax.devices()
+        {"JAX_PLATFORMS": "axon"},
+        # r2's shape: relay env present (dead endpoint) + axon platform
+        {"JAX_PLATFORMS": "axon", "PALLAS_AXON_POOL_IPS": "10.255.255.1"},
+        # no platform hints at all (a future driver that sets nothing)
+        {},
+        # driver that pre-sets CPU flags but never imported jax: still must
+        # not import jax in the parent (routing is env-independent)
+        {"JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    ],
+    ids=["axon-no-poolips", "axon-dead-relay", "bare", "cpu-preset"],
+)
+def test_dryrun_parent_never_imports_jax(tmp_path, hostile_env):
+    proc = _run_parent(tmp_path, hostile_env)
+    out = proc.stdout + proc.stderr
+    assert POISON not in out, f"parent imported jax:\n{out}"
+    assert proc.returncode == 0, out
+    assert "provisioning" in out, out
+    assert "PARENT-DONE" in out, out
+
+
+def test_dryrun_parent_completes_fast_under_dead_relay(tmp_path):
+    """Routing must finish in seconds even with a wedged/dead relay env.
+
+    The driver's budget is ~240s for the WHOLE dryrun; the parent's share
+    (decide + print plan) must be near-zero. 30s is a generous ceiling on a
+    loaded 1-core box.
+    """
+    try:
+        proc = _run_parent(
+            tmp_path,
+            {"JAX_PLATFORMS": "axon", "PALLAS_AXON_POOL_IPS": "10.255.255.1"},
+            timeout_s=30.0,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.fail("dryrun parent hung >30s under a dead-relay env")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_dryrun_child_env_is_scrubbed_cpu(tmp_path):
+    """The step-child env must force CPU + n-device flag and drop relay vars."""
+    import __graft_entry__ as ge
+
+    captured = {}
+
+    def fake_run_many(jobs, timeout_s, poll_s):
+        for name, argv, env in jobs:
+            captured[name] = env
+        return {name: (0, "") for name, _, _ in jobs}
+
+    from metaopt_tpu.utils import procs
+
+    orig = procs.run_many_with_deadline
+    procs.run_many_with_deadline = fake_run_many
+    try:
+        env_backup = dict(os.environ)
+        os.environ["PALLAS_AXON_POOL_IPS"] = "10.255.255.1"
+        os.environ.pop("_METAOPT_TPU_DRYRUN_PLAN_ONLY", None)
+        try:
+            ge._dryrun_in_child(8)
+        finally:
+            os.environ.clear()
+            os.environ.update(env_backup)
+    finally:
+        procs.run_many_with_deadline = orig
+    assert set(captured) == {"A", "B", "C", "D"}
+    for name, env in captured.items():
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+        assert "PALLAS_AXON_POOL_IPS" not in env
+        assert env["_METAOPT_TPU_DRYRUN_CHILD"] == "1"
+        assert env["_METAOPT_TPU_DRYRUN_STEP"] == name
