@@ -21,9 +21,16 @@ A CPU fallback run (relay unreachable after 3 probes) is TPE-only and
 embeds the newest committed ``benchmarks/results/bench_tpu_*.json`` under
 ``last_good_tpu`` so the driver's record always carries the TPU story.
 
-Prints ONE JSON line:
+Output contract (the driver keeps only a bounded TAIL of stdout, so the
+LAST line must be small and self-contained):
+- the full record is written to ``benchmarks/results/bench_<backend>_<date>
+  .json``;
+- the final stdout line is ONE compact JSON object:
     {"metric": "tpe_suggest_ms_per_point_10k_obs_pool8", "value": <ms>,
-     "unit": "ms", "vs_baseline": <numpy_ms / jax_ms speedup>, "extra": ...}
+     "unit": "ms", "vs_baseline": <numpy_ms/jax_ms>, "backend": ...,
+     "artifact": <relpath>, "tpu_record_from": "live"|"last_good:<file>",
+     "mfu_seq256": ..., "mfu_seq512": ..., "mfu_seq1024": ...,
+     "resnet50_mfu": ...}
 """
 
 from __future__ import annotations
@@ -317,10 +324,26 @@ def bench_resnet(on_tpu: bool) -> dict:
         )
     jax.block_until_ready(loss)
     dt_ms = (time.perf_counter() - t0) * 1000 / n_steps
-    return {
+    out = {
         f"resnet{depth}_step_ms": round(dt_ms, 3),
         f"resnet{depth}_images_per_s": round(batch / (dt_ms / 1000)),
     }
+    # conv FLOPs come from XLA's own cost model (no hand-derived formula
+    # for the CIFAR-stem ResNet variant) → an explicit resnet MFU field,
+    # so nobody misreads the transformer MFU as covering this model
+    try:
+        cost = step.lower(params, batch_stats, opt_state).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        peak = peak_flops(jax.devices()[0])
+        if flops > 0 and peak:
+            out[f"resnet{depth}_mfu"] = round(
+                (flops / (dt_ms / 1000)) / peak, 4
+            )
+    except Exception:  # cost analysis is best-effort, never sinks the bench
+        pass
+    return out
 
 
 def bench_flash_pallas() -> dict:
@@ -531,9 +554,6 @@ def main() -> None:
             else f"rc={rc}: {out[-200:]}"
         )
     if on_tpu:
-        # headline MFU = the realistic-shape number the judge tracks
-        if "mfu_seq256" in model_stats:
-            model_stats["mfu"] = model_stats["mfu_seq256"]
         mosaic = probe_mosaic()
     else:
         mosaic = "skipped-cpu"
@@ -557,7 +577,44 @@ def main() -> None:
             **model_stats,
         },
     }
-    print(json.dumps(result))
+    # Full record goes to a file; stdout gets ONE compact line. The driver
+    # keeps only a bounded TAIL of output, so a giant single-line record
+    # gets its head (the "{"metric": ..." part) truncated and parses as
+    # nothing — exactly what r3's record died of.
+    backend = result["extra"]["backend"]
+    stamp = time.strftime("%Y-%m-%d", time.gmtime())
+    results_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmarks", "results")
+    os.makedirs(results_dir, exist_ok=True)
+    artifact = os.path.join(results_dir, f"bench_{backend}_{stamp}.json")
+    with open(artifact, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"full record: {artifact}", flush=True)
+
+    # headline fields ride in the compact line; on a CPU-fallback run they
+    # come from the newest committed TPU artifact instead of the live run
+    src = result["extra"]
+    tpu_record_from = "live"
+    if backend != "tpu" and isinstance(src.get("last_good_tpu"), dict):
+        src = src["last_good_tpu"].get("extra", src["last_good_tpu"])
+        tpu_record_from = "last_good:" + str(
+            result["extra"].get("last_good_tpu_file"))
+    compact = {
+        "metric": result["metric"],
+        "value": result["value"],
+        "unit": result["unit"],
+        "vs_baseline": result["vs_baseline"],
+        "backend": backend,
+        "artifact": os.path.relpath(
+            artifact, os.path.dirname(os.path.abspath(__file__))),
+        "tpu_record_from": tpu_record_from,
+    }
+    for key in ("mfu_seq256", "mfu_seq512", "mfu_seq1024", "resnet50_mfu",
+                "transformer_tokens_per_s_seq512", "resnet50_images_per_s",
+                "flash_vs_chunked_crossover"):
+        if key in src:
+            compact[key] = src[key]
+    print(json.dumps(compact))
 
 
 def stage_main(name: str) -> None:
