@@ -45,7 +45,7 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--pool-size", type=int, dest="pool_size")
         sp.add_argument(
             "--ledger",
-            help="ledger spec: 'memory', a directory path, or 'coord://host:port'",
+            help="ledger spec: 'memory', a dir path (native engine preferred), 'native:<dir>', 'file:<dir>', or 'coord://host:port'",
         )
 
     hunt = sub.add_parser("hunt", help="run the optimization loop")
@@ -143,7 +143,7 @@ def build_parser() -> argparse.ArgumentParser:
     ls.add_argument("--config", help="framework config YAML")
     ls.add_argument(
         "--ledger",
-        help="ledger spec: 'memory', a dir path, 'native:<dir>', "
+        help="ledger spec: 'memory', a dir path (native engine preferred), 'native:<dir>', 'file:<dir>', "
              "or coord://host:port",
     )
     ls.add_argument("--json", action="store_true", dest="as_json")
@@ -221,7 +221,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="test: emit the check report as JSON")
     db.add_argument("--config", help="framework config YAML")
     db.add_argument("--ledger",
-                    help="ledger spec: 'memory', a dir path, 'native:<dir>', "
+                    help="ledger spec: 'memory', a dir path (native engine preferred), 'native:<dir>', 'file:<dir>', "
                          "or coord://host:port")
 
     web = sub.add_parser(
@@ -229,7 +229,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     web.add_argument("--config", help="framework config YAML")
     web.add_argument("--ledger",
-                     help="ledger spec: 'memory', a dir path, 'native:<dir>', "
+                     help="ledger spec: 'memory', a dir path (native engine preferred), 'native:<dir>', 'file:<dir>', "
                           "or coord://host:port")
     web.add_argument("--host", default="127.0.0.1")
     web.add_argument("--port", type=int, default=0,
@@ -286,7 +286,16 @@ def _make_ledger_from_spec(spec: Optional[str], cfg: Dict[str, Any]):
     from metaopt_tpu.ledger.backends import ledger_from_spec
 
     if spec is None:
-        lcfg = dict(cfg.get("ledger") or {"type": "file"})
+        lcfg = cfg.get("ledger")
+        if not lcfg:
+            # no spec and no (or an empty) ledger config section: same
+            # native-preferred resolution a bare --ledger PATH gets —
+            # `ledger: {}` must mean the persistent local default, never
+            # a silent in-memory backend (make_ledger's type default)
+            from metaopt_tpu.ledger.backends import local_ledger
+
+            return local_ledger(os.path.expanduser("~/.metaopt_tpu/ledger"))
+        lcfg = dict(lcfg)
         if lcfg.get("type") == "file" and not lcfg.get("path"):
             lcfg["path"] = os.path.expanduser("~/.metaopt_tpu/ledger")
         return make_ledger(lcfg)

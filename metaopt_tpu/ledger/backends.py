@@ -22,6 +22,7 @@ from __future__ import annotations
 import fcntl
 import itertools
 import json
+import logging
 import os
 import threading
 import time
@@ -32,6 +33,8 @@ from typing import Any, Dict, List, Optional
 
 from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.utils.registry import Registry
+
+log = logging.getLogger(__name__)
 
 #: MemoryLedger instance counter (cursor epochs; see fetch_completed_since)
 _MEM_EPOCHS = itertools.count()
@@ -684,7 +687,8 @@ class FileLedger(LedgerBackend):
 def ledger_from_spec(spec: str) -> LedgerBackend:
     """Build a backend from the user-facing spec string.
 
-    ``"memory"`` | a directory path (file backend) | ``"native:<dir>"`` |
+    ``"memory"`` | a bare directory path (native engine preferred, see
+    :func:`local_ledger`) | ``"native:<dir>"`` | ``"file:<dir>"`` |
     ``"coord://host:port"`` — the same grammar the CLI's ``--ledger``
     accepts, shared here so the Python API (client.build_experiment)
     and the CLI can never diverge.
@@ -698,7 +702,69 @@ def ledger_from_spec(spec: str) -> LedgerBackend:
         )
     if spec.startswith("native:"):
         return make_ledger({"type": "native", "path": spec[len("native:"):]})
-    return make_ledger({"type": "file", "path": spec})
+    if spec.startswith("file:"):
+        return make_ledger({"type": "file", "path": spec[len("file:"):]})
+    return local_ledger(spec)
+
+
+def _has_python_file_store(path: str) -> bool:
+    """True if ``path`` already holds file-backend experiments whose trials
+    live as per-trial JSON documents and no native engine log: opening
+    those with the engine would hide every existing trial from resume.
+
+    The signal is an actual trial document, not a bare ``trials/`` dir —
+    the native backend inherits FileLedger's create_experiment, which
+    makes an (empty) ``trials/`` before the engine's ``store/`` exists; a
+    doc-only experiment must keep resolving to native, or a crash between
+    create and first register would silently flip the directory to the
+    file backend while live native handles keep writing to the engine."""
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return False
+    for name in entries:
+        edir = os.path.join(path, name)
+        if not os.path.isfile(os.path.join(edir, "experiment.json")):
+            continue
+        if os.path.exists(os.path.join(edir, "store")):
+            continue  # engine log present: native territory
+        tdir = os.path.join(edir, "trials")
+        try:
+            has_doc = any(fn.endswith(".json") for fn in os.listdir(tdir))
+        except OSError:
+            has_doc = False
+        if has_doc:
+            return True
+    return False
+
+
+def local_ledger(path: str) -> LedgerBackend:
+    """Backend for a bare local directory: native engine preferred.
+
+    The C++ ledgerstore engine runs the trial hot path ~78× faster than
+    the JSON file backend at sweep scale (5.4M vs 69k trials/hour @1024
+    workers measured), so a bare path gets it by default. Falls back to
+    the pure-Python file backend when (a) the directory already holds a
+    file-backend store — its per-trial JSON documents are invisible to
+    the engine and resume must keep working — or (b) the engine cannot
+    compile/load here (no g++). Both fallbacks log the reason; the
+    ``native:<dir>`` / ``file:<dir>`` spec prefixes pin a choice.
+    """
+    if _has_python_file_store(path):
+        log.info(
+            "ledger %s: existing file-backend store found; keeping the "
+            "pure-Python file backend (migrate via 'mtpu db dump/load' "
+            "into a 'native:' ledger for the fast engine)", path,
+        )
+        return make_ledger({"type": "file", "path": path})
+    try:
+        return make_ledger({"type": "native", "path": path})
+    except Exception as exc:
+        log.warning(
+            "ledger %s: native engine unavailable (%s); falling back to "
+            "the pure-Python file backend", path, exc,
+        )
+        return make_ledger({"type": "file", "path": path})
 
 
 def make_ledger(config: Dict[str, Any]) -> LedgerBackend:

@@ -28,6 +28,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from metaopt_tpu.ledger.backends import (
+    DuplicateExperimentError,
     DuplicateTrialError,
     FileLedger,
     ledger_registry,
@@ -80,14 +81,69 @@ class NativeFileLedger(FileLedger):
                 self._handles[key] = ent
             return ent
 
+    def create_experiment(self, config: Dict[str, Any]) -> None:
+        """FileLedger's create + an engine-ghost heal.
+
+        A register racing a past ``delete_experiment`` can append an op-1
+        put AFTER the wipe record (registers serialize on the engine
+        flock, not the doc lock) — a new life of the name must not
+        inherit it, so an existing engine store is wiped again before the
+        doc is written (the file backend heals the same race for JSON
+        ghost docs). Residual window: a ghost landing between this wipe
+        and the doc write still survives — closing it needs per-life
+        generation fencing inside the engine, which trades more format
+        churn than the microsecond window justifies.
+        """
+        import shutil
+
+        name = config["name"]
+        with self._locked(name):
+            epath = os.path.join(self._edir(name), "experiment.json")
+            if os.path.exists(epath):
+                raise DuplicateExperimentError(name)
+            tdir = os.path.join(self._edir(name), "trials")
+            if os.path.isdir(tdir):
+                shutil.rmtree(tdir, ignore_errors=True)
+            os.makedirs(tdir, exist_ok=True)
+            if os.path.isdir(os.path.join(self._edir(name), "store")):
+                h, hlock = self._handle(name)
+                with hlock:
+                    self._lib.ls_wipe(h)
+            self._write_json(epath, config)
+
     def delete_experiment(self, name: str) -> bool:
-        """Unsupported: other processes may hold open engine handles whose
-        flock identity a log-file unlink would silently fork (two writers,
-        one believing it has the lock) — the same hazard FileLedger's
-        tombstone delete avoids, but here the open file lives inside the
-        C++ engine where we cannot tombstone. Callers get False and leave
-        the documents in place."""
-        return False
+        """Delete = engine WIPE record + removal of the JSON documents.
+
+        The engine's lock file and log inode must survive (other processes
+        hold open handles whose flock identity an unlink would silently
+        fork — two writers, each believing it has the lock), so deletion is
+        an APPENDED op-5 record: every handle replays it on its next locked
+        op and drops all entries. Only the side documents (experiment.json,
+        trials index, any stray per-trial JSON) are removed; the ``store/``
+        directory stays, and a recreated experiment of the same name reuses
+        the same engine log under the same lock. Mixed-version caveat: a
+        pre-wipe build replaying the log ignores op 5 and still sees the
+        old trials (MIGRATION.md)."""
+        import shutil
+
+        with self._locked(name):
+            epath = os.path.join(self._edir(name), "experiment.json")
+            if not os.path.exists(epath):
+                return False
+            h, hlock = self._handle(name)
+            with hlock:
+                if self._lib.ls_wipe(h) != 0:
+                    raise RuntimeError(f"ledgerstore wipe failed: {name}")
+            os.remove(epath)
+            for side in ("trials.index.json",):
+                try:
+                    os.remove(os.path.join(self._edir(name), side))
+                except OSError:
+                    pass
+            shutil.rmtree(os.path.join(self._edir(name), "trials"),
+                          ignore_errors=True)
+            self._idx_cache.pop(name, None)
+        return True
 
     def _take(self, ptr) -> str:
         """Copy + free a malloc'd engine string."""

@@ -86,6 +86,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ls_count.argtypes = [ctypes.c_void_p, c_char_p]
     lib.ls_compact.restype = ctypes.c_long
     lib.ls_compact.argtypes = [ctypes.c_void_p]
+    lib.ls_wipe.restype = ctypes.c_int
+    lib.ls_wipe.argtypes = [ctypes.c_void_p]
     lib.ls_free.argtypes = [ctypes.c_void_p]
     return lib
 

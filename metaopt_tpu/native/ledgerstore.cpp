@@ -253,6 +253,21 @@ class Store {
     return out;
   }
 
+  // Wipe: drop every entry (experiment deletion). Deletion is an APPENDED
+  // record, never an unlink — the lock file and log inode survive, so
+  // handles held by other processes replay the wipe on their next locked
+  // op instead of forking the lock identity (the hazard that made delete
+  // unsupported before). Seqs keep climbing and the epoch is unchanged:
+  // a fetch_since cursor from the pre-wipe life stays valid and simply
+  // sees nothing until post-wipe records land.
+  int wipe() {
+    Guard g(this);
+    Record r{5, "", "", "", "", 0.0};
+    if (!append(r)) return -1;
+    apply(r);
+    return 0;
+  }
+
   std::string get(const char* key) {
     Guard g(this);
     auto it = index_.find(key);
@@ -473,6 +488,11 @@ class Store {
     // every applied record advances the log clock — deterministic across
     // processes because all replay the identical record stream
     ++seq_;
+    if (r.op == 5) {  // wipe: the log's "delete everything" tombstone
+      index_.clear();
+      order_.clear();
+      return;
+    }
     if (r.op == 1) {
       if (index_.count(r.key)) return;  // insert-only
       index_[r.key] =
@@ -645,6 +665,8 @@ long ls_count(void* h, const char* status_csv) {
 }
 
 long ls_compact(void* h) { return static_cast<Store*>(h)->compact(); }
+
+int ls_wipe(void* h) { return static_cast<Store*>(h)->wipe(); }
 
 void ls_free(char* p) { free(p); }
 

@@ -14,7 +14,7 @@ import pytest
 
 from metaopt_tpu.cli import main as cli_main
 from metaopt_tpu.ledger import Experiment
-from metaopt_tpu.ledger.backends import make_ledger
+from metaopt_tpu.ledger.backends import ledger_from_spec, make_ledger
 
 HERE = os.path.dirname(__file__)
 BLACK_BOX = os.path.join(HERE, "black_box.py")
@@ -41,7 +41,7 @@ class TestHuntDemo:
         assert out["best"]["objective"] >= 0
 
         # ledger docs round-trip through a fresh reader (resume semantics)
-        exp = Experiment("demo", make_ledger({"type": "file", "path": ledger_dir}))
+        exp = Experiment("demo", ledger_from_spec(ledger_dir))
         exp.configure()
         trials = exp.fetch_completed_trials()
         assert len(trials) == 12
@@ -57,7 +57,7 @@ class TestHuntDemo:
             BLACK_BOX, "-x~uniform(-50, 50)", "--fail-above=0",
         ])
         out = json.loads(capsys.readouterr().out)
-        exp = Experiment("brk", make_ledger({"type": "file", "path": ledger_dir}))
+        exp = Experiment("brk", ledger_from_spec(ledger_dir))
         exp.configure()
         broken = exp.fetch_trials("broken")
         completed = exp.fetch_completed_trials()
@@ -98,10 +98,10 @@ class TestHuntDemo:
         ])
         assert rc == 0
         capsys.readouterr()
-        from metaopt_tpu.ledger.backends import make_ledger
+        from metaopt_tpu.ledger.backends import ledger_from_spec, make_ledger
 
         exp = Experiment(
-            "pbt-demo", make_ledger({"type": "file", "path": ledger_dir})
+            "pbt-demo", ledger_from_spec(ledger_dir)
         ).configure()
         completed = exp.fetch_completed_trials()
         warm = {
@@ -133,7 +133,7 @@ class TestHuntDemo:
         # trial ever executes twice
         assert out["completed_by_worker"] >= 9
         exp = Experiment(
-            "par", make_ledger({"type": "file", "path": ledger_dir})
+            "par", ledger_from_spec(ledger_dir)
         ).configure()
         done = exp.fetch_completed_trials()
         assert len(done) >= 9

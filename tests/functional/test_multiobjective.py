@@ -11,7 +11,7 @@ import urllib.request
 
 from metaopt_tpu.cli import main as cli_main
 from metaopt_tpu.io.webapi import make_server, start_in_thread
-from metaopt_tpu.ledger.backends import make_ledger
+from metaopt_tpu.ledger.backends import ledger_from_spec, make_ledger
 
 HERE = os.path.dirname(__file__)
 REPO = os.path.dirname(os.path.dirname(HERE))
@@ -41,7 +41,7 @@ class TestMultiObjectiveHunt:
         capsys.readouterr()
 
         # every completed trial carries the 2-vector
-        ledger = make_ledger({"type": "file", "path": ledger_dir})
+        ledger = ledger_from_spec(ledger_dir)
         done = ledger.fetch("mo", "completed")
         assert len(done) == 10
         assert all(len(t.objectives) == 2 for t in done)
@@ -155,7 +155,7 @@ class TestMultiObjectiveHunt:
         ])
         assert rc == 0
         capsys.readouterr()
-        ledger = make_ledger({"type": "file", "path": ledger_dir})
+        ledger = ledger_from_spec(ledger_dir)
         code, payload = pareto_series(ledger, "single")
         assert code == 400
         assert "single objective" in payload["error"]

@@ -10,7 +10,7 @@ import json
 import pytest
 
 from metaopt_tpu.cli import main as cli_main
-from metaopt_tpu.ledger.backends import make_ledger
+from metaopt_tpu.ledger.backends import ledger_from_spec, make_ledger
 from metaopt_tpu.ledger.trial import Trial
 
 
@@ -45,7 +45,7 @@ class TestDumpLoad:
         assert rc == 0
         assert "loaded document + 3 trial(s)" in capsys.readouterr().out
 
-        restored = make_ledger({"type": "file", "path": dst})
+        restored = ledger_from_spec(dst)
         doc = restored.load_experiment("src")
         assert doc["max_trials"] == 3 and doc["space"] == {"x": "uniform(0, 1)"}
         done = restored.fetch("src", "completed")
