@@ -238,7 +238,7 @@ class Transformer(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, src, tgt_in, *, train: bool):
+    def __call__(self, src, tgt_in, *, train: bool, features: bool = False):
         emb = nn.Embed(
             self.vocab, self.d_model, dtype=jnp.bfloat16, name="embed",
             embedding_init=nn.with_partitioning(
@@ -280,6 +280,11 @@ class Transformer(nn.Module):
                 y, enc, causal_mask, cross_mask, train
             )
         y = nn.LayerNorm(dtype=jnp.float32, name="dec_ln")(y)
+        if features:
+            # pre-readout features for the blocked-xent loss (ops/xent.py):
+            # the caller folds the tied embedding table in blockwise and
+            # the (B, T, V) logits tensor never exists
+            return y
         # weight-tied readout against the (bf16) embedding table
         logits = jnp.einsum(
             "btd,vd->btv", y.astype(jnp.bfloat16), emb.embedding
@@ -307,17 +312,38 @@ def make_model(hparams: Optional[Dict[str, Any]] = None, **overrides) -> Transfo
     )
 
 
+#: vocab size above which loss_fn switches to the blocked xent: below it
+#: the (B, T, V) tensor is small and the plain optax path is simpler/faster
+_BLOCKED_XENT_MIN_VOCAB = 8192
+
+
 def loss_fn(model, params, batch, dropout_key, moe_aux_weight: float = 0.01):
     src, tgt = batch
     bos = jnp.ones((tgt.shape[0], 1), tgt.dtype)
     tgt_in = jnp.concatenate([bos, tgt[:, :-1]], axis=1)
-    logits, mutated = model.apply(
-        {"params": params}, src, tgt_in, train=True,
+    blocked = model.vocab >= _BLOCKED_XENT_MIN_VOCAB
+    out, mutated = model.apply(
+        {"params": params}, src, tgt_in, train=True, features=blocked,
         rngs={"dropout": dropout_key},
         mutable=["aux_loss"],
     )
-    loss = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
     mask = (tgt != 0).astype(jnp.float32)
+    if blocked:
+        # large vocab: fold the tied readout into a blocked online-softmax
+        # xent (ops/xent.py) — the f32 (B, T, V) logits tensor (2.1 GB at
+        # the flagship bench shape) never exists in HBM
+        from metaopt_tpu.ops.xent import blocked_softmax_xent, pick_block_v
+
+        emb = params["embed"]["embedding"]
+        if hasattr(emb, "unbox"):  # nn.Partitioned leaf (sharded init path)
+            emb = emb.unbox()
+        feats = out.reshape(-1, out.shape[-1]).astype(jnp.bfloat16)
+        loss = blocked_softmax_xent(
+            feats, emb.astype(jnp.bfloat16), tgt.reshape(-1),
+            pick_block_v(model.vocab),
+        ).reshape(tgt.shape)
+    else:
+        loss = optax.softmax_cross_entropy_with_integer_labels(out, tgt)
     total = (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     aux = jax.tree.leaves(mutated.get("aux_loss", {}))
     if aux:  # switch load-balancing term from MoE layers
