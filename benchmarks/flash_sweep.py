@@ -1,0 +1,149 @@
+"""Flash-vs-chunked attention sweep: seq × block shapes, fwd + bwd.
+
+VERDICT r3 #4: the Pallas kernel tied the chunked twin at seq 256 and was
+never measured where flash matters. This sweep times forward and full-grad
+steps for both impls at seq 256→4096 (causal-masked and unmasked), over a
+small grid of (block_q, block_k), and records per-seq ratios plus the
+crossover — the data that decides attention_impl()'s TPU default.
+
+Run on the real chip (no JAX_PLATFORMS override):
+    python benchmarks/flash_sweep.py [--save] [--quick]
+
+One JSON line per (seq, masked, impl, blocks) config; with --save they land
+in benchmarks/results/flash_sweep_<date>.jsonl and a summary line records
+the crossover.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from metaopt_tpu.utils.procs import preflight_backend  # noqa: E402
+
+
+def time_fn(fn, repeats):
+    fn()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn()
+    import jax
+
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) * 1000 / repeats
+
+
+def main() -> None:
+    save = "--save" in sys.argv
+    quick = "--quick" in sys.argv
+    preflight_backend(90.0, announce="flash_sweep: TPU unreachable; aborting")
+    import jax
+    import jax.numpy as jnp
+
+    from metaopt_tpu.ops.attention import flash_attention
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": "not on tpu; sweep is meaningless"}))
+        return
+
+    seqs = (256, 1024, 2048) if quick else (256, 512, 1024, 2048, 4096)
+    blocks = ((128, 128), (256, 256)) if quick else (
+        (128, 128), (128, 256), (256, 128), (256, 256), (128, 512),
+        (256, 512),
+    )
+    h, d = 8, 64
+    rows = []
+    for seq in seqs:
+        b = max(1, 8192 // seq)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (b, seq, h, d), jnp.bfloat16) / (d ** 0.5)
+        k = jax.random.normal(ks[1], (b, seq, h, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, seq, h, d), jnp.bfloat16)
+        causal = jnp.broadcast_to(
+            jnp.tril(jnp.ones((seq, seq), bool))[None], (b, seq, seq)
+        )
+        for masked in (False, True):
+            mask = causal if masked else None
+            ref = None
+            configs = [("chunked", 128, 128), ("chunked", 128, 256)]
+            configs += [("pallas", bq, bk) for bq, bk in blocks]
+            for impl, bq, bk in configs:
+                tag = f"{impl}-{bq}x{bk}"
+                try:
+                    fwd = jax.jit(lambda q, k, v, m, impl=impl, bq=bq, bk=bk:
+                                  flash_attention(q, k, v, m, impl=impl,
+                                                  block_q=bq, block_k=bk,
+                                                  interpret=False))
+
+                    def loss(q, k, v, m, impl=impl, bq=bq, bk=bk):
+                        return jnp.sum(flash_attention(
+                            q, k, v, m, impl=impl, block_q=bq, block_k=bk,
+                            interpret=False) ** 2)
+
+                    gfn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                    out = jax.block_until_ready(fwd(q, k, v, mask))
+                    if ref is None:
+                        ref = out.astype(jnp.float32)
+                        err = 0.0
+                    else:
+                        err = float(jnp.max(jnp.abs(
+                            out.astype(jnp.float32) - ref)))
+                    reps = 5 if quick else 10
+                    fwd_ms = time_fn(
+                        lambda: jax.block_until_ready(fwd(q, k, v, mask)),
+                        reps)
+                    bwd_ms = time_fn(
+                        lambda: jax.block_until_ready(gfn(q, k, v, mask)),
+                        reps)
+                    row = {"seq": seq, "batch": b, "masked": masked,
+                           "impl": impl, "block_q": bq, "block_k": bk,
+                           "fwd_ms": round(fwd_ms, 3),
+                           "grad_ms": round(bwd_ms, 3),
+                           "max_abs_err": round(err, 5)}
+                except Exception as exc:  # noqa: BLE001 — record, keep sweeping
+                    row = {"seq": seq, "batch": b, "masked": masked,
+                           "impl": impl, "block_q": bq, "block_k": bk,
+                           "error": f"{type(exc).__name__}: {exc}"[:300]}
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+
+    # crossover: per (seq, masked), best pallas grad_ms vs best chunked
+    summary = {"metric": "flash_vs_chunked", "points": []}
+    for seq in seqs:
+        for masked in (False, True):
+            sub = [r for r in rows if r["seq"] == seq
+                   and r["masked"] == masked and "error" not in r]
+            pal = [r for r in sub if r["impl"] == "pallas"]
+            chk = [r for r in sub if r["impl"] == "chunked"]
+            if not pal or not chk:
+                continue
+            bp = min(pal, key=lambda r: r["grad_ms"])
+            bc = min(chk, key=lambda r: r["grad_ms"])
+            summary["points"].append({
+                "seq": seq, "masked": masked,
+                "pallas_ms": bp["grad_ms"], "pallas_blocks":
+                    [bp["block_q"], bp["block_k"]],
+                "chunked_ms": bc["grad_ms"],
+                "speedup": round(bc["grad_ms"] / bp["grad_ms"], 3),
+                "fwd_speedup": round(
+                    min(chk, key=lambda r: r["fwd_ms"])["fwd_ms"]
+                    / min(pal, key=lambda r: r["fwd_ms"])["fwd_ms"], 3),
+            })
+    wins = [p["seq"] for p in summary["points"] if p["speedup"] >= 1.15]
+    summary["crossover_seq"] = min(wins) if wins else None
+    print(json.dumps(summary), flush=True)
+    if save:
+        stamp = time.strftime("%Y-%m-%d", time.gmtime())
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "results", f"flash_sweep_{stamp}.jsonl")
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+            f.write(json.dumps(summary) + "\n")
+        print(f"saved: {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

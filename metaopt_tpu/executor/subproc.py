@@ -262,7 +262,8 @@ class SubprocessExecutor(Executor):
                     "interrupted",
                     note=f"TPU backend unreachable; parked "
                     f"{self.park_max_s:.0f}s without recovery (trial "
-                    f"released for retry — see `mtpu resume`)",
+                    f"released for retry)",
+                    requeue=True,
                 )
         result = self._execute_inner(trial, heartbeat, judge)
         # arm ONLY on the executor's own wall-clock-timeout note (a
@@ -275,6 +276,27 @@ class SubprocessExecutor(Executor):
                 "trial %s broke by timeout — probing the TPU backend "
                 "before the next launch", trial.id[:8],
             )
+            # Attribution: if the backend is down RIGHT NOW, the timeout
+            # was infrastructure, not the user script — "broken" would
+            # count it toward max_broken and a relay wedge would abort the
+            # hunt (the r3 smoke lost 3 PPO trials exactly this way).
+            # Reclassify as interrupted: the reservation is released for
+            # retry and the next execute() parks on the armed suspicion.
+            verdict = self._probe_with_beats(heartbeat)
+            if verdict is None:
+                return ExecutionResult(
+                    "interrupted",
+                    note="lost reservation while attributing a timeout",
+                )
+            if verdict is False:
+                return ExecutionResult(
+                    "interrupted",
+                    note=f"{result.note}, with the TPU backend unreachable "
+                         "— attributed to a device wedge; trial released "
+                         "for retry",
+                    requeue=True,
+                )
+            self._suspect_device = False  # backend fine: a real timeout
         return result
 
     def _execute_inner(

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import time
+from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -32,6 +33,9 @@ class WorkerStats:
     interrupted: int = 0
     pruned: int = 0
     suspended: int = 0
+    #: trials bounced back to 'new' after an infrastructure failure
+    #: (executor set ExecutionResult.requeue) — retried, not lost
+    requeued: int = 0
     idle_cycles: int = 0
     events: List[Dict[str, Any]] = field(default_factory=list)
     #: producer timing aggregates (observe/suggest latency, SURVEY.md §5)
@@ -75,6 +79,11 @@ def workon(
     else:
         raise ValueError(f"unknown producer_mode {producer_mode!r}")
     stats = WorkerStats()
+    # per-trial requeue budget: a wedge-attributed infrastructure failure
+    # releases the trial (ExecutionResult.requeue), but only this many
+    # times — a permanently dead backend must converge to interrupted
+    max_requeues = 3
+    requeues: Dict[str, int] = defaultdict(int)
 
     def heartbeat_for(trial: Trial):
         def beat() -> bool:
@@ -153,6 +162,21 @@ def workon(
                 log.warning(
                     "%s lost reservation of %s before result push", worker_id, trial.id
                 )
+        elif res.requeue and requeues[trial.id] < max_requeues:
+            # infrastructure failure (device wedge/park budget): release
+            # the trial back to 'new' so this or another worker retries it
+            # once the device recovers; bounded per trial so a permanently
+            # dead backend still converges to interrupted
+            requeues[trial.id] += 1
+            trial.reset_to_new()
+            experiment.ledger.update_trial(
+                trial, expected_status="reserved", expected_worker=worker_id
+            )
+            stats.requeued += 1
+            log.warning(
+                "%s requeued trial %s (%d/%d): %s", worker_id,
+                trial.id[:8], requeues[trial.id], max_requeues, res.note,
+            )
         else:
             trial.transition(res.status)
             experiment.ledger.update_trial(

@@ -41,7 +41,10 @@ def trial(i=0):
 
 
 class TestBreaker:
-    def test_timeout_breakage_arms_suspicion(self, monkeypatch, tmp_path):
+    def test_timeout_with_live_backend_stays_broken(self, monkeypatch,
+                                                    tmp_path):
+        """Probe answers: the timeout was the user script's own — broken
+        counts toward max_broken and suspicion clears (no parking)."""
         ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: True)
         monkeypatch.setattr(
             TPUExecutor.__mro__[1], "_execute_inner",
@@ -51,7 +54,25 @@ class TestBreaker:
         assert not ex._suspect_device
         res = ex.execute(trial(0))
         assert res.status == "broken"
-        assert ex._suspect_device
+        assert not ex._suspect_device
+
+    def test_timeout_with_dead_backend_reclassifies(self, monkeypatch,
+                                                    tmp_path):
+        """Probe fails: the timeout is attributed to the wedge — the trial
+        comes back interrupted (released for retry, NOT counted by
+        max_broken) and the next execute() parks on the armed suspicion.
+        This is the r3-smoke scenario (3 PPO trials broken by a mid-run
+        relay wedge) the breaker exists to prevent."""
+        ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: False)
+        monkeypatch.setattr(
+            TPUExecutor.__mro__[1], "_execute_inner",
+            lambda self, t, heartbeat=None, judge=None: ExecutionResult(
+                "broken", note="timeout after 900.0s"),
+        )
+        res = ex.execute(trial(0))
+        assert res.status == "interrupted"
+        assert "attributed to a device wedge" in res.note
+        assert ex._suspect_device, "next execute() must park"
 
     def test_non_timeout_breakage_does_not_arm(self, monkeypatch, tmp_path):
         ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: True)
@@ -161,3 +182,50 @@ class TestBreaker:
         assert res.status == "completed"
         assert beats["n"] >= 2, \
             "the reservation must beat WHILE the probe child runs"
+
+
+class TestWedgeRecoveryHunt:
+    def test_hunt_survives_wedge_with_zero_broken(self, monkeypatch,
+                                                  tmp_path):
+        """End-to-end (the r4 smoke contract): a mid-hunt wedge costs NO
+        broken trials — the timed-out trial is requeued, workers park, and
+        once the backend answers again the hunt finishes max_trials."""
+        from metaopt_tpu.ledger.backends import make_ledger
+        from metaopt_tpu.ledger.experiment import Experiment
+        from metaopt_tpu.worker.loop import workon
+
+        state = {"wedged": True, "execs": 0}
+
+        def probe(**_):
+            # recovers after two probe attempts
+            state["wedged"] = state.get("probes", 0) < 1
+            state["probes"] = state.get("probes", 0) + 1
+            return not state["wedged"]
+
+        ex = make_executor(monkeypatch, tmp_path, probe=probe,
+                           park_poll_s=0.02, park_max_s=30.0)
+
+        def fake_inner(self, t, heartbeat=None, judge=None):
+            state["execs"] += 1
+            if state["execs"] == 2 and state["wedged"]:
+                return ExecutionResult("broken", note="timeout after 5.0s")
+            return ExecutionResult(
+                "completed",
+                results=[{"name": "o", "type": "objective",
+                          "value": float(state["execs"])}],
+            )
+
+        monkeypatch.setattr(TPUExecutor.__mro__[1], "_execute_inner",
+                            fake_inner)
+        ledger = make_ledger({"type": "memory"})
+        exp = Experiment(
+            "wedge", ledger,
+            space=SpaceBuilder().build(["t.py", "-x~uniform(0, 1)"])[0],
+            max_trials=5, algorithm={"random": {"seed": 0}},
+        ).configure()
+        stats = workon(exp, ex, worker_id="w0", max_broken=3)
+        assert stats.broken == 0
+        assert stats.requeued == 1
+        assert stats.completed == 5
+        done = ledger.fetch("wedge", "completed")
+        assert len(done) == 5
