@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import logging
 import time
-from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -81,9 +80,10 @@ def workon(
     stats = WorkerStats()
     # per-trial requeue budget: a wedge-attributed infrastructure failure
     # releases the trial (ExecutionResult.requeue), but only this many
-    # times — a permanently dead backend must converge to interrupted
+    # times — a permanently dead backend must converge to interrupted.
+    # The count persists on the trial document (resources), so N workers
+    # (or a restarted worker) share ONE budget instead of multiplying it.
     max_requeues = 3
-    requeues: Dict[str, int] = defaultdict(int)
 
     def heartbeat_for(trial: Trial):
         def beat() -> bool:
@@ -162,22 +162,36 @@ def workon(
                 log.warning(
                     "%s lost reservation of %s before result push", worker_id, trial.id
                 )
-        elif res.requeue and requeues[trial.id] < max_requeues:
+        elif (res.requeue
+              and int(trial.resources.get("requeues", 0)) < max_requeues):
             # infrastructure failure (device wedge/park budget): release
             # the trial back to 'new' so this or another worker retries it
             # once the device recovers; bounded per trial so a permanently
             # dead backend still converges to interrupted
-            requeues[trial.id] += 1
+            n_req = int(trial.resources.get("requeues", 0)) + 1
+            trial.resources["requeues"] = n_req
             trial.reset_to_new()
-            experiment.ledger.update_trial(
+            ok = experiment.ledger.update_trial(
                 trial, expected_status="reserved", expected_worker=worker_id
             )
-            stats.requeued += 1
-            log.warning(
-                "%s requeued trial %s (%d/%d): %s", worker_id,
-                trial.id[:8], requeues[trial.id], max_requeues, res.note,
-            )
+            if ok:
+                stats.requeued += 1
+                log.warning(
+                    "%s requeued trial %s (%d/%d): %s", worker_id,
+                    trial.id[:8], n_req, max_requeues, res.note,
+                )
+            else:
+                log.warning(
+                    "%s lost reservation of %s before requeue write-back",
+                    worker_id, trial.id,
+                )
         else:
+            if res.requeue:
+                # the executor flagged a retry, but the shared budget is
+                # spent — the stored outcome must say what actually
+                # happens (nothing, until a human resumes it)
+                res.note += (" (requeue budget exhausted — "
+                             "see `mtpu resume`)")
             trial.transition(res.status)
             experiment.ledger.update_trial(
                 trial, expected_status="reserved", expected_worker=worker_id
