@@ -517,6 +517,20 @@ def main() -> None:
     tpe1k = build_tpe(1_000)
     tpe1k.suggest(pool)
     jax_1k_ms = time_fn(lambda: tpe1k.suggest(pool), repeats=r(20)) / pool
+    flat_16k = {}
+    if on_tpu:
+        # the north star claims per-suggestion cost flat PAST 10k — put a
+        # 16k point on the record (TPU only: a CPU fallback run must stay
+        # slim, and the claim is about the chip)
+        tpe16k = build_tpe(16_000)
+        tpe16k.suggest(pool)
+        jax_16k_ms = time_fn(lambda: tpe16k.suggest(pool),
+                             repeats=r(10)) / pool
+        flat_16k = {
+            "jax_16k_obs_ms_per_point": round(jax_16k_ms, 3),
+            "flatness_16k_over_1k": round(
+                jax_16k_ms / max(jax_1k_ms, 1e-9), 2),
+        }
     model_stats = {}
     # CPU fallback = TPE-only: model steps on CPU produce mfu 0.0 noise and
     # burn minutes of driver budget nobody wants; the TPU story rides along
@@ -571,6 +585,7 @@ def main() -> None:
             "suggest_after_observe_100ms_gap_ms": round(after_observe_ms, 3),
             "jax_1k_obs_ms_per_point": round(jax_1k_ms, 3),
             "flatness_10k_over_1k": round(jax_ms / max(jax_1k_ms, 1e-9), 2),
+            **flat_16k,
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0]),
             "mosaic_compile_probe": mosaic,

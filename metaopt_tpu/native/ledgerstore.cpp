@@ -40,6 +40,9 @@
 #include <ctime>
 #include <string>
 #include <unordered_map>
+#include <algorithm>
+#include <set>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -201,26 +204,16 @@ class Store {
   }
 
   // Reserve the oldest 'new' entry (min (sort_key, key)): status →
-  // reserved, stamp worker + hb. Returns envelope or "".
+  // reserved, stamp worker + hb. Returns envelope or "". O(log n): the
+  // FIFO candidate is the head of new_set_.
   std::string reserve(const char* worker) {
     Guard g(this);
-    const std::string* best = nullptr;
-    const Entry* best_e = nullptr;
-    for (const auto& key : order_) {
-      auto it = index_.find(key);
-      if (it == index_.end() || it->second.status != "new") continue;
-      const Entry& e = it->second;
-      if (!best || e.order < best_e->order ||
-          (e.order == best_e->order && key < *best)) {
-        best = &it->first;
-        best_e = &e;
-      }
-    }
-    if (!best) return "";
-    Record r{3, *best, "reserved", worker, "", now_s()};
+    if (new_set_.empty()) return "";
+    const std::string best = new_set_.begin()->second;
+    Record r{3, best, "reserved", worker, "", now_s()};
     if (!append(r)) return "";
     apply(r);
-    return envelope(*best, index_.at(*best));
+    return envelope(best, index_.at(best));
   }
 
   int beat(const char* key, const char* worker) {
@@ -239,15 +232,14 @@ class Store {
     Guard g(this);
     const double cutoff = now_s() - timeout_s;
     std::string out;
-    for (const auto& key : order_) {
-      auto it = index_.find(key);
-      if (it == index_.end() || it->second.status != "reserved" ||
-          it->second.heartbeat >= cutoff)
-        continue;
+    // reserved_set_ orders by heartbeat: stale claims are a prefix, so
+    // each release pops the head (apply() moves it to new_set_)
+    while (!reserved_set_.empty() && reserved_set_.begin()->first < cutoff) {
+      const std::string key = reserved_set_.begin()->second;
       Record r{3, key, "new", "", "", 0.0};
       if (!append(r)) break;
       apply(r);
-      out += envelope(key, it->second);  // post-release: status back to 'new'
+      out += envelope(key, index_.at(key));  // post-release: back to 'new'
       out += '\n';
     }
     return out;
@@ -307,6 +299,26 @@ class Store {
              static_cast<unsigned long long>(cur_epoch),
              static_cast<unsigned long long>(seq_));
     std::string out = head;
+    if (wanted.size() == 1 && wanted[0] == "completed") {
+      // the hot path (incremental observe): completion events are an
+      // append-only, seq-sorted vector — binary search to the cursor
+      // instead of scanning every entry. Events may repeat a key
+      // (re-marks); dedup here, and re-check the entry's CURRENT status
+      // so a completed→new reset never resurfaces.
+      auto lb = std::lower_bound(
+          completed_events_.begin(), completed_events_.end(),
+          std::make_pair(seq + 1, std::string()));
+      std::set<std::string> seen;
+      for (auto ev = lb; ev != completed_events_.end(); ++ev) {
+        if (!seen.insert(ev->second).second) continue;
+        auto it = index_.find(ev->second);
+        if (it == index_.end() || it->second.status != "completed") continue;
+        if (it->second.last_seq <= seq) continue;
+        out += envelope(ev->second, it->second);
+        out += '\n';
+      }
+      return out;
+    }
     for (const auto& key : order_) {
       auto it = index_.find(key);
       if (it == index_.end()) continue;
@@ -322,10 +334,13 @@ class Store {
     Guard g(this);
     std::vector<std::string> wanted = split_csv(status_csv);
     long n = 0;
-    for (const auto& key : order_) {
-      auto it = index_.find(key);
-      if (it == index_.end()) continue;
-      if (wanted.empty() || contains(wanted, it->second.status)) ++n;
+    if (wanted.empty()) {
+      for (const auto& kv : status_counts_) n += kv.second;
+      return n;
+    }
+    for (const auto& w : wanted) {
+      auto it = status_counts_.find(w);
+      if (it != status_counts_.end()) n += it->second;
     }
     return n;
   }
@@ -380,11 +395,14 @@ class Store {
     // (two records per live key, in order_ order) — cursor consistency
     // across processes depends on every handle agreeing on (epoch, seq)
     seq_ = 0;
+    completed_events_.clear();  // seqs changed; rebuild sorted (below)
     for (const auto& key : order_) {
       auto it = index_.find(key);
       if (it == index_.end()) continue;
       seq_ += 2;
       it->second.last_seq = seq_;
+      if (it->second.status == "completed")
+        completed_events_.push_back({seq_, key});
     }
     // a log of pure put records can legally GROW slightly (two records per
     // key after compaction): that is still success, not an IO failure —
@@ -479,6 +497,7 @@ class Store {
                      O_CREAT | O_RDWR | O_APPEND, 0666);
     index_.clear();
     order_.clear();
+    clear_indexes();
     seq_ = 0;  // fresh log = fresh epoch: seqs restart with the replay
     foreign_ = false;  // the replacement may be OURS again
     read_or_init_header();
@@ -491,6 +510,7 @@ class Store {
     if (r.op == 5) {  // wipe: the log's "delete everything" tombstone
       index_.clear();
       order_.clear();
+      clear_indexes();
       return;
     }
     if (r.op == 1) {
@@ -498,11 +518,13 @@ class Store {
       index_[r.key] =
           Entry{r.status, r.worker, 0.0, r.heartbeat, r.payload, seq_};
       order_.push_back(r.key);
+      index_add(r.key, index_.at(r.key), seq_);
       return;
     }
     auto it = index_.find(r.key);
     if (it == index_.end()) return;  // mark/beat for unknown key: ignore
     Entry& e = it->second;
+    index_remove(it->first, e);
     e.last_seq = seq_;
     if (r.op == 2) {
       e.status = r.status;
@@ -516,6 +538,7 @@ class Store {
     } else if (r.op == 4) {
       e.heartbeat = r.heartbeat;
     }
+    index_add(it->first, e, seq_);
   }
 
   // Replay records other processes appended since our last look. Truncates
@@ -598,6 +621,38 @@ class Store {
   bool foreign_ = false;  // log format unknown: read-as-empty, no writes
   std::unordered_map<std::string, Entry> index_;
   std::vector<std::string> order_;  // insertion order, for FIFO reserve
+  // Derived indexes, maintained by apply() so every op that scanned the
+  // whole entry map is O(1)/O(log n). At 10k trials the O(n) scans made
+  // the per-trial cost linear in history (count alone was a third of a
+  // 10k sweep's wall time); these keep the coordination plane flat.
+  std::unordered_map<std::string, long> status_counts_;
+  std::set<std::pair<double, std::string>> new_set_;       // (order, key)
+  std::set<std::pair<double, std::string>> reserved_set_;  // (heartbeat, key)
+  // (seq, key) appended whenever a record leaves an entry 'completed';
+  // possibly duplicated per key (re-marks) — readers dedup
+  std::vector<std::pair<uint64_t, std::string>> completed_events_;
+
+  void index_remove(const std::string& key, const Entry& e) {
+    auto c = status_counts_.find(e.status);
+    if (c != status_counts_.end() && --(c->second) <= 0)
+      status_counts_.erase(c);
+    if (e.status == "new") new_set_.erase({e.order, key});
+    else if (e.status == "reserved") reserved_set_.erase({e.heartbeat, key});
+  }
+
+  void index_add(const std::string& key, const Entry& e, uint64_t seq) {
+    ++status_counts_[e.status];
+    if (e.status == "new") new_set_.insert({e.order, key});
+    else if (e.status == "reserved") reserved_set_.insert({e.heartbeat, key});
+    else if (e.status == "completed") completed_events_.push_back({seq, key});
+  }
+
+  void clear_indexes() {
+    status_counts_.clear();
+    new_set_.clear();
+    reserved_set_.clear();
+    completed_events_.clear();
+  }
 };
 
 char* dup_or_null(const std::string& s) {

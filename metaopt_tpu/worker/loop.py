@@ -169,8 +169,10 @@ def workon(
             # once the device recovers; bounded per trial so a permanently
             # dead backend still converges to interrupted
             n_req = int(trial.resources.get("requeues", 0)) + 1
-            trial.resources["requeues"] = n_req
             trial.reset_to_new()
+            # AFTER reset_to_new, which clears resources — the counter
+            # must survive into the ledger or the budget never binds
+            trial.resources["requeues"] = n_req
             ok = experiment.ledger.update_trial(
                 trial, expected_status="reserved", expected_worker=worker_id
             )
@@ -208,6 +210,21 @@ def workon(
                 "note": res.note,
             }
         )
+        if res.requeue and res.status != "completed" and int(
+                trial.resources.get("requeues", 0)) >= max_requeues:
+            # the backend stayed dead through every park + retry this
+            # trial was entitled to (~3 park budgets of wall clock) —
+            # continuing would have the producer mint replacement trials
+            # forever, each doomed to the same grind. Stop THIS worker;
+            # the interrupted trials resume with `mtpu resume` once the
+            # device returns. (A terminal-interrupted trial satisfies no
+            # stop condition: it is neither completed nor broken.)
+            log.error(
+                "%s: TPU backend did not recover within trial %s's requeue "
+                "budget — stopping worker (state preserved; `mtpu resume` "
+                "when the device returns)", worker_id, trial.id[:8],
+            )
+            break
 
     # final observe so the algorithm state is current for callers (the
     # coordinator-hosted algorithm observes inside its own produce cycles)

@@ -229,3 +229,42 @@ class TestWedgeRecoveryHunt:
         assert stats.completed == 5
         done = ledger.fetch("wedge", "completed")
         assert len(done) == 5
+
+    def test_permanently_dead_backend_converges_to_interrupted(
+            self, monkeypatch, tmp_path):
+        """The shared requeue budget must BIND: with the backend dead
+        forever, each trial is retried max_requeues times (counter
+        persisted on the trial document, surviving reset_to_new) and then
+        parks as interrupted — never an infinite requeue loop."""
+        from metaopt_tpu.ledger.backends import make_ledger
+        from metaopt_tpu.ledger.experiment import Experiment
+        from metaopt_tpu.worker.loop import workon
+
+        ex = make_executor(monkeypatch, tmp_path, probe=lambda **_: False,
+                           park_poll_s=0.01, park_max_s=0.02)
+
+        def fake_inner(self, t, heartbeat=None, judge=None):
+            return ExecutionResult("broken", note="timeout after 1.0s")
+
+        monkeypatch.setattr(TPUExecutor.__mro__[1], "_execute_inner",
+                            fake_inner)
+        ledger = make_ledger({"type": "memory"})
+        exp = Experiment(
+            "deadwedge", ledger,
+            space=SpaceBuilder().build(["t.py", "-x~uniform(0, 1)"])[0],
+            max_trials=2, algorithm={"random": {"seed": 0}},
+        ).configure()
+        stats = workon(exp, ex, worker_id="w0", max_broken=50,
+                       max_idle_cycles=30)
+        assert stats.broken == 0
+        # the first trial burns its whole budget (3 requeues), goes
+        # terminal-interrupted, and the WORKER stops — were it to continue,
+        # the producer would mint doomed replacement trials forever
+        assert stats.requeued == 3
+        assert stats.interrupted == 1
+        left = ledger.fetch("deadwedge", "interrupted")
+        assert len(left) == 1
+        t = left[0]
+        assert int(t.resources.get("requeues", 0)) == 3
+        assert any("requeue budget exhausted" in (e.get("note") or "")
+                   for e in stats.events if e["trial"] == t.id)
