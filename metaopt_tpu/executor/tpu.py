@@ -79,11 +79,17 @@ class TPUExecutor(SubprocessExecutor):
                 note=f"no {self.n_chips}-chip sub-slice became available "
                 f"within {self.allocate_timeout_s}s",
             )
-        trial.resources = {
-            "chips": block.chips,
-            "slice": {"start": block.start, "size": block.size},
-            "env": chip_env(block),
-        }
+        # MERGE the chip assignment — never replace the dict: the worker
+        # loop persists its per-trial requeue budget in this same dict
+        # (worker/loop.py), and clobbering it makes the budget infinite
+        # (the exact wedge-convergence failure the breaker exists to stop)
+        trial.resources.update(
+            {
+                "chips": block.chips,
+                "slice": {"start": block.start, "size": block.size},
+                "env": chip_env(block),
+            }
+        )
         log.debug("trial %s pinned to chips %s", trial.id[:8], block.chips)
 
         def beating() -> bool:

@@ -152,6 +152,7 @@ def workon(
             raise
 
         trial.exit_code = res.exit_code
+        requeue_budget_spent = False
         if res.status == "completed":
             ok = experiment.push_results(trial, res.results)
             if ok:
@@ -194,6 +195,7 @@ def workon(
                 # happens (nothing, until a human resumes it)
                 res.note += (" (requeue budget exhausted — "
                              "see `mtpu resume`)")
+                requeue_budget_spent = True
             trial.transition(res.status)
             experiment.ledger.update_trial(
                 trial, expected_status="reserved", expected_worker=worker_id
@@ -210,15 +212,19 @@ def workon(
                 "note": res.note,
             }
         )
-        if res.requeue and res.status != "completed" and int(
-                trial.resources.get("requeues", 0)) >= max_requeues:
+        if requeue_budget_spent:
             # the backend stayed dead through every park + retry this
-            # trial was entitled to (~3 park budgets of wall clock) —
-            # continuing would have the producer mint replacement trials
-            # forever, each doomed to the same grind. Stop THIS worker;
-            # the interrupted trials resume with `mtpu resume` once the
-            # device returns. (A terminal-interrupted trial satisfies no
-            # stop condition: it is neither completed nor broken.)
+            # trial was entitled to (~3 park budgets of wall clock) and
+            # the final attempt just went terminal — continuing would
+            # have the producer mint replacement trials forever, each
+            # doomed to the same grind. Stop THIS worker; the interrupted
+            # trials resume with `mtpu resume` once the device returns.
+            # (A terminal-interrupted trial satisfies no stop condition:
+            # it is neither completed nor broken.) NOTE: this must key on
+            # the budget-exhausted branch having actually run, not on the
+            # stored counter — right after the LAST successful requeue
+            # the counter already reads max_requeues, and breaking there
+            # would strand the trial in 'new' instead of interrupted.
             log.error(
                 "%s: TPU backend did not recover within trial %s's requeue "
                 "budget — stopping worker (state preserved; `mtpu resume` "
