@@ -180,7 +180,8 @@ def transformer_train_flops(b, s, d, layers, d_ff, vocab) -> float:
     return 3.0 * b * s * (enc + dec + readout)
 
 
-def bench_transformer(on_tpu: bool, seq: int = 256, batch: int = 64) -> dict:
+def bench_transformer(on_tpu: bool, seq: int = 256, batch: int = 64,
+                      force_materializing_xent: bool = False) -> dict:
     """Train-step time + MFU for the flagship model on the current backend.
 
     TPU shapes are Transformer-base (BASELINE config 4) at realistic
@@ -188,17 +189,27 @@ def bench_transformer(on_tpu: bool, seq: int = 256, batch: int = 64) -> dict:
     is not the number behind BASELINE's trials/hour north star. Attention
     rides the chunked flash path (the TPU default in
     ops/attention.attention_impl) so the O(S²) logits tensor never exists.
+
+    ``force_materializing_xent``: the A/B control — disable the blocked
+    online-softmax xent (ops/xent.py) so the f32 (B, T, V) logits tensor IS
+    materialized, measuring what the blocked loss actually buys on the chip.
     """
     import jax
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from metaopt_tpu.models import transformer as transformer_mod
     from metaopt_tpu.models.data import synthetic_seq2seq
     from metaopt_tpu.models.transformer import (
         init_sharded, make_model, make_train_step,
     )
     from metaopt_tpu.parallel.mesh import trial_mesh, use_mesh
     from metaopt_tpu.parallel.sharding import shard_batch
+
+    if force_materializing_xent:
+        # runs in a dedicated --stage child, so the module-global poke
+        # cannot leak into any other measurement
+        transformer_mod._BLOCKED_XENT_MIN_VOCAB = 1 << 62
 
     if on_tpu:  # Transformer-base (BASELINE config 4 trial workload)
         cfg = {"d_model": 512, "n_heads": 8, "n_layers": 6, "d_ff": 2048,
@@ -265,7 +276,12 @@ def bench_transformer(on_tpu: bool, seq: int = 256, batch: int = 64) -> dict:
     mfu = (flops / (dt_ms / 1000)) / peak if peak else 0.0
     from metaopt_tpu.ops.attention import attention_impl
 
+    xent = ("materializing" if force_materializing_xent
+            or cfg["vocab"] < transformer_mod._BLOCKED_XENT_MIN_VOCAB
+            else "blocked")
     tag = f"_seq{seq}" if on_tpu else ""
+    if force_materializing_xent:
+        tag += "_matxent"
     return {
         f"transformer_step_ms{tag}": round(dt_ms, 3),
         f"transformer_tokens_per_s{tag}": round(batch * seq / (dt_ms / 1000)),
@@ -273,6 +289,7 @@ def bench_transformer(on_tpu: bool, seq: int = 256, batch: int = 64) -> dict:
         f"transformer_config{tag}": {
             **cfg, "batch": batch, "seq": seq,
             "attention": attention_impl() or "reference",
+            "xent": xent,
         },
     }
 
@@ -519,24 +536,25 @@ def main() -> None:
     jax_1k_ms = time_fn(lambda: tpe1k.suggest(pool), repeats=r(20)) / pool
     flat_16k = {}
     if on_tpu:
-        # the north star claims per-suggestion cost flat PAST 10k — put a
-        # 16k point on the record (TPU only: a CPU fallback run must stay
-        # slim, and the claim is about the chip)
-        tpe16k = build_tpe(16_000)
-        tpe16k.suggest(pool)
-        jax_16k_ms = time_fn(lambda: tpe16k.suggest(pool),
-                             repeats=r(10)) / pool
-        flat_16k = {
-            "jax_16k_obs_ms_per_point": round(jax_16k_ms, 3),
-            "flatness_16k_over_1k": round(
-                jax_16k_ms / max(jax_1k_ms, 1e-9), 2),
-        }
+        # the north star claims per-suggestion cost flat PAST 10k — put
+        # 16k AND 32k points on the record (TPU only: a CPU fallback run
+        # must stay slim, and the claim is about the chip)
+        for n in (16_000, 32_000):
+            tpe_n = build_tpe(n)
+            tpe_n.suggest(pool)
+            jax_n_ms = time_fn(lambda: tpe_n.suggest(pool),
+                               repeats=r(10)) / pool
+            k = f"{n // 1000}k"
+            flat_16k[f"jax_{k}_obs_ms_per_point"] = round(jax_n_ms, 3)
+            flat_16k[f"flatness_{k}_over_1k"] = round(
+                jax_n_ms / max(jax_1k_ms, 1e-9), 2)
     model_stats = {}
     # CPU fallback = TPE-only: model steps on CPU produce mfu 0.0 noise and
     # burn minutes of driver budget nobody wants; the TPU story rides along
     # from the last committed TPU run instead
     stages = (
         ("transformer-256", "transformer-512", "transformer-1024",
+         "xent-256", "xent-512", "xent-1024",
          "resnet", "flash")
         if on_tpu else ()
     )
@@ -561,6 +579,12 @@ def main() -> None:
                     parsed = candidate
                     break
         if isinstance(parsed, dict):
+            # a stage child whose OWN preflight degraded to CPU exits 0
+            # with CPU-shaped keys — that is a failed capture, not data
+            # (the relay can die between our init and the child's)
+            if parsed.pop("stage_backend", "tpu") != "tpu":
+                model_stats[f"{name}_bench_error"] = "stage degraded to cpu"
+                continue
             model_stats.update(parsed)
             continue
         model_stats[f"{name}_bench_error"] = (
@@ -573,11 +597,23 @@ def main() -> None:
         mosaic = "skipped-cpu"
         model_stats.update(last_good_tpu_record())
 
+    # the xent A/B verdict: blocked-loss step-time win per seq (>1 = the
+    # blocked online-softmax xent is faster than materializing (B, T, V))
+    for s in (256, 512, 1024):
+        blocked_ms = model_stats.get(f"transformer_step_ms_seq{s}")
+        mat_ms = model_stats.get(f"transformer_step_ms_seq{s}_matxent")
+        if blocked_ms and mat_ms:
+            model_stats[f"xent_blocked_step_speedup_seq{s}"] = round(
+                mat_ms / blocked_ms, 3)
+
+    from metaopt_tpu.utils.provenance import provenance
+
     result = {
         "metric": "tpe_suggest_ms_per_point_10k_obs_pool8",
         "value": round(jax_ms, 3),
         "unit": "ms",
         "vs_baseline": round(numpy_ms / jax_ms, 2),
+        **provenance(),
         "extra": {
             "numpy_reference_ms_per_point": round(numpy_ms, 3),
             "single_suggest_ms": round(single_ms, 3),
@@ -610,7 +646,13 @@ def main() -> None:
     # come from the newest committed TPU artifact instead of the live run
     src = result["extra"]
     tpu_record_from = "live"
+    value_tpu_last_good = None
     if backend != "tpu" and isinstance(src.get("last_good_tpu"), dict):
+        # the cross-round `value` series must not silently flip substrate:
+        # a CPU-fallback run says so (stale) and carries the TPU value it
+        # would have refreshed, so drivers comparing `value` across rounds
+        # compare like with like (VERDICT r4 weak #3)
+        value_tpu_last_good = src["last_good_tpu"].get("value")
         src = src["last_good_tpu"].get("extra", src["last_good_tpu"])
         tpu_record_from = "last_good:" + str(
             result["extra"].get("last_good_tpu_file"))
@@ -620,11 +662,24 @@ def main() -> None:
         "unit": result["unit"],
         "vs_baseline": result["vs_baseline"],
         "backend": backend,
+        "stale": backend != "tpu",
+        # a TPU run whose model stages all deadlined still exits 0 — the
+        # stage-error count lets consumers (watch_tpu.py) reject a gutted
+        # capture instead of checkpointing it as done
+        "stage_errors": sum(1 for k in result["extra"]
+                            if k.endswith("_bench_error")),
+        "commit": result.get("commit"),
         "artifact": os.path.relpath(
             artifact, os.path.dirname(os.path.abspath(__file__))),
         "tpu_record_from": tpu_record_from,
     }
+    if value_tpu_last_good is not None:
+        compact["value_tpu_last_good"] = value_tpu_last_good
     for key in ("mfu_seq256", "mfu_seq512", "mfu_seq1024", "resnet50_mfu",
+                "xent_blocked_step_speedup_seq256",
+                "xent_blocked_step_speedup_seq512",
+                "xent_blocked_step_speedup_seq1024",
+                "flatness_16k_over_1k", "flatness_32k_over_1k",
                 "transformer_tokens_per_s_seq512", "resnet50_images_per_s",
                 "flash_vs_chunked_crossover"):
         if key in src:
@@ -642,12 +697,21 @@ def stage_main(name: str) -> None:
         seq = int(name.split("-")[1]) if "-" in name else 256
         # equal token count per step (16k): batch trades off against seq
         stats = bench_transformer(on_tpu, seq=seq, batch=16384 // seq)
+    elif name.startswith("xent-"):
+        # the A/B control: same shapes, blocked loss disabled, so the
+        # (B, T, V) logits tensor is materialized (VERDICT r4 #3)
+        seq = int(name.split("-")[1])
+        stats = bench_transformer(on_tpu, seq=seq, batch=16384 // seq,
+                                  force_materializing_xent=True)
     elif name == "resnet":
         stats = bench_resnet(on_tpu)
     elif name == "flash":
         stats = bench_flash_pallas()
     else:
         raise SystemExit(f"unknown stage {name!r}")
+    # the parent checks this observed stamp: its own preflight passing
+    # says nothing about THIS child's (the relay can wedge in between)
+    stats["stage_backend"] = jax.default_backend()
     print(json.dumps(stats))
 
 

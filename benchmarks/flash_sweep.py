@@ -141,6 +141,10 @@ def main() -> None:
         wins = [p["seq"] for p in summary["points"]
                 if p["masked"] == want_masked and p["speedup"] >= 1.15]
         summary[f"crossover_seq_{label}"] = min(wins) if wins else None
+    from metaopt_tpu.utils.provenance import provenance
+
+    stamp_fields = provenance(backend=jax.default_backend())
+    summary.update(stamp_fields)
     print(json.dumps(summary), flush=True)
     if save:
         stamp = time.strftime("%Y-%m-%d", time.gmtime())
@@ -148,7 +152,7 @@ def main() -> None:
                             "results", f"flash_sweep_{stamp}.jsonl")
         with open(path, "w") as f:
             for r in rows:
-                f.write(json.dumps(r) + "\n")
+                f.write(json.dumps({**r, **stamp_fields}) + "\n")
             f.write(json.dumps(summary) + "\n")
         print(f"saved: {path}", flush=True)
 

@@ -34,7 +34,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import tempfile
 import time
@@ -124,34 +123,7 @@ CONFIGS = {
 preflight_tpu = tpu_backend_reachable
 
 
-def _kill_by_env_marker(marker: str) -> int:
-    """SIGKILL every process whose environment carries ``marker``.
-
-    Trials are ``start_new_session``'d by the executor, so neither killing
-    the hunt nor its process group reaches them — but they all inherit the
-    hunt's env. Sweeping /proc by marker reaps the whole tree, freeing the
-    single-slot relay for the next config.
-    """
-    import signal as _signal
-
-    me = os.getpid()
-    killed = 0
-    try:
-        pids = os.listdir("/proc")
-    except OSError:  # non-Linux host: nothing to sweep, don't sink the run
-        return 0
-    for pid_s in pids:
-        if not pid_s.isdigit() or int(pid_s) == me:
-            continue
-        try:
-            with open(f"/proc/{pid_s}/environ", "rb") as f:
-                if marker.encode() not in f.read():
-                    continue
-            os.kill(int(pid_s), _signal.SIGKILL)
-            killed += 1
-        except (OSError, PermissionError):
-            continue
-    return killed
+from metaopt_tpu.utils.procs import run_swept  # noqa: E402
 
 
 def _partial_progress(ledger_path: str, name: str, wall_s: float) -> dict:
@@ -222,26 +194,14 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str,
         # claim-retry backoff loop
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
-    marker = f"MTPU_BENCH_MARKER={name}-{os.getpid()}-{int(time.time())}"
-    env["MTPU_BENCH_MARKER"] = marker.split("=", 1)[1]
     t0 = time.time()
-    proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True,
-                            start_new_session=True)
-    try:
-        stdout, stderr = proc.communicate(timeout=config_timeout_s)
-    except subprocess.TimeoutExpired:
-        # the hunt's trials live in their own sessions (executor uses
-        # start_new_session), so no single kill/killpg reaches them; sweep
-        # every process carrying this config's env marker instead — an
-        # orphaned trial would keep the single-slot relay claimed and
-        # wedge every subsequent config
-        proc.kill()
-        _kill_by_env_marker(marker)
-        try:
-            stdout, stderr = proc.communicate(timeout=10)
-        except subprocess.TimeoutExpired:
-            stdout, stderr = "", "unreapable after kill"
+    # trials live in their own sessions (executor start_new_session), so a
+    # deadline must sweep by env marker, not killpg — run_swept owns that
+    rc, stdout, stderr = run_swept(
+        argv, config_timeout_s, env=env,
+        marker=f"{name}-{os.getpid()}-{int(time.time())}",
+    )
+    if rc is None:
         out = {"config": name, "trials": max_trials,
                "wall_s": round(time.time() - t0, 1),
                "backend": "cpu" if on_cpu else backend,
@@ -255,7 +215,7 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str,
 
     out = {"config": name, "trials": max_trials, "wall_s": round(wall, 1),
            "backend": "cpu" if on_cpu else backend}
-    if proc.returncode != 0:
+    if rc != 0:
         out["error"] = stderr[-500:]
         return _annotate_failure(out, on_cpu)
     try:
@@ -270,6 +230,7 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str,
         best_objective=(summary.get("best") or {}).get("objective"),
         broken=summary["total"].get("broken", 0),
         pruned=summary.get("pruned_by_worker", 0),
+        requeued=summary.get("requeued_by_worker", 0),
     )
     return out
 
@@ -297,6 +258,8 @@ def main() -> int:
     explicit_cap = args.config_timeout_s
     cap = explicit_cap or (1800.0 if args.scale == "smoke" else 7200.0)
 
+    from metaopt_tpu.utils.provenance import provenance
+
     results = []
     with tempfile.TemporaryDirectory(prefix="mtpu_bench_") as root:
         for name, spec in CONFIGS.items():
@@ -305,18 +268,29 @@ def main() -> int:
             scale = 1.0 if explicit_cap else spec.get("timeout_scale", 1.0)
             res = run_config(name, spec, args.scale, root, backend,
                              cap * scale)
+            res.update(provenance())
             print(json.dumps(res), flush=True)
             results.append(res)
 
     ok = [r for r in results if "error" not in r]
+    # the per-row "backend" is the COMMANDED one; prove the chip actually
+    # answered through the whole sweep with a post-run probe — consumers
+    # gating on "this really ran on TPU" (benchmarks/watch_tpu.py) key on
+    # backend_observed, not backend
+    observed = backend
+    if backend == "tpu":
+        observed = "tpu" if tpu_backend_reachable(60.0) else "unverified"
     summary = {
         "summary": True,
         "scale": args.scale,
         "backend": backend,
+        "backend_observed": observed,
         "configs_ok": len(ok),
         "configs_total": len(results),
         "total_trials": sum(r["trials"] for r in ok),
+        "total_requeued": sum(r.get("requeued", 0) for r in ok),
         "total_wall_s": round(sum(r["wall_s"] for r in results), 1),
+        **provenance(),
     }
     print(json.dumps(summary))
     if args.save:

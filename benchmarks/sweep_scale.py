@@ -141,6 +141,8 @@ def main():
     ap.add_argument("--save", action="store_true")
     args = ap.parse_args()
 
+    from metaopt_tpu.utils.provenance import provenance
+
     rows = []
     with tempfile.TemporaryDirectory(prefix="mtpu_scale_") as root:
         for kind in args.backends:
@@ -148,6 +150,9 @@ def main():
                 row = run_backend(kind, root, args.max_trials)
             except Exception as err:  # a missing toolchain must not sink all
                 row = {"backend": kind, "error": f"{type(err).__name__}: {err}"}
+            # rows self-describe (the two coord rows 100x apart in the r4
+            # record straddled an optimization commit, undetectably)
+            row.update(provenance())
             print(json.dumps(row), flush=True)
             rows.append(row)
     if args.save:

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Opportunistic TPU capture loop: probe the relay, pounce on recovery.
+
+The axon relay wedges for hours at a time (it ate the round-3 AND round-4
+bench windows); running the perf sweep only at end-of-round loses that race
+every time. This watcher closes VERDICT r4 missing #1: it probes the backend
+in a disposable deadline child every few minutes and, the moment the relay
+answers, runs the full capture sequence — bench.py (TPE flatness to 32k,
+MFU seq 256/512/1024, blocked-xent A/B, resnet, flash twins), the flash
+block-shape sweep, and the 5-config smoke — refreshing the committed
+last-good artifacts that bench.py's CPU-fallback line rides on.
+
+Steps that complete are checkpointed in results/watch_state.json, so a relay
+that flaps mid-sequence costs only the interrupted step: the next recovery
+resumes from the first step still pending. Every transition is appended to
+results/watch_log.jsonl with a provenance stamp.
+
+Run from the repo root (survives the session via nohup):
+    nohup python benchmarks/watch_tpu.py >/tmp/watch_tpu.out 2>&1 &
+
+The parent NEVER imports jax — a wedged relay can hang any process whose
+interpreter has initialized the axon backend (utils/procs.py doctrine).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from metaopt_tpu.utils.procs import (  # noqa: E402
+    run_swept,
+    tpu_backend_reachable,
+)
+from metaopt_tpu.utils.provenance import provenance  # noqa: E402
+
+RESULTS = os.path.join(REPO, "benchmarks", "results")
+STATE = os.path.join(RESULTS, "watch_state.json")
+LOG = os.path.join(RESULTS, "watch_log.jsonl")
+
+#: a step that fails WITH the relay up (deterministic bug, bad flag) must
+#: not be retried forever — give up after this many attempts and say so
+MAX_ATTEMPTS = 4
+
+#: capture sequence: (name, argv, deadline_s, tpu_proofs). Ordered by
+#: value-per-minute — the bench record is what the driver parses, so it
+#: goes first; the smoke is the longest and most interruption-tolerant, so
+#: it goes last. EVERY string in ``tpu_proofs`` must appear in the step's
+#: stdout for it to count as captured: each step's own preflight silently
+#: degrades to CPU when the relay dies between our probe and its first jax
+#: init, and a CPU artifact is not a capture. bench/flash_sweep stamp the
+#: OBSERVED ``jax.default_backend()``; run.py rows echo the commanded
+#: backend, so its proof is the summary's post-sweep ``backend_observed``
+#: probe. bench additionally must have run every model stage — a TPE-only
+#: record with eight deadlined stages exits 0 too, and checkpointing it
+#: would strip the MFU/xent/flash story from the round.
+STEPS = (
+    ("bench", [sys.executable, os.path.join(REPO, "bench.py")],
+     5400.0, ('"backend": "tpu"', '"stage_errors": 0')),
+    ("flash_sweep",
+     [sys.executable, os.path.join(REPO, "benchmarks", "flash_sweep.py"),
+      "--save"], 5400.0, ('"backend": "tpu"',)),
+    ("smoke",
+     [sys.executable, os.path.join(REPO, "benchmarks", "run.py"),
+      "--scale", "smoke", "--backend", "tpu", "--save"],
+     9000.0, ('"backend_observed": "tpu"',)),
+)
+
+
+def log_event(event: str, **fields) -> None:
+    os.makedirs(RESULTS, exist_ok=True)
+    row = {"event": event, **fields, **provenance()}
+    with open(LOG, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(json.dumps(row), flush=True)
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_state(state: dict) -> None:
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, STATE)
+
+
+def run_step(name: str, argv, deadline_s: float, tpu_proofs) -> bool:
+    """Run one capture step under a hard deadline; True = captured on TPU.
+
+    Success needs rc 0 AND every ``tpu_proofs`` string in stdout (see
+    STEPS). On deadline, run_swept reaps the step's whole tree by env
+    marker — trials inside the smoke live in their own sessions, and an
+    orphan would keep the single-slot relay claimed forever.
+    """
+    log_event("step_start", step=name, deadline_s=deadline_s)
+    env = dict(os.environ)
+    # each step decides its own backend via its preflight; never inherit a
+    # CPU force from the operator's shell
+    env.pop("JAX_PLATFORMS", None)
+    t0 = time.time()
+    rc, stdout, stderr = run_swept(
+        argv, deadline_s, env=env, cwd=REPO,
+        marker=f"watch-{name}-{os.getpid()}-{int(time.time())}",
+    )
+    on_tpu = all(p in stdout for p in tpu_proofs)
+    ok = rc == 0 and on_tpu
+    log_event("step_end", step=name, rc="timeout" if rc is None else rc,
+              on_tpu=on_tpu, wall_s=round(time.time() - t0, 1),
+              tail=(stdout + stderr)[-600:])
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval-s", type=float, default=240.0,
+                    help="seconds between relay probes while it is down")
+    ap.add_argument("--probe-timeout-s", type=float, default=90.0)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe; capture if up, then exit")
+    ap.add_argument("--steps", nargs="*", choices=[s[0] for s in STEPS],
+                    default=None, help="subset of capture steps")
+    ap.add_argument("--reset", action="store_true",
+                    help="forget previously completed steps")
+    args = ap.parse_args()
+
+    if args.reset and os.path.exists(STATE):
+        os.remove(STATE)
+    # the probe honors an inherited JAX_PLATFORMS=cpu (it means "never
+    # touch the relay" elsewhere) — but a watcher whose whole job is the
+    # relay must not be silently disarmed by a leftover shell export
+    os.environ.pop("JAX_PLATFORMS", None)
+    wanted = [s for s in STEPS if args.steps is None or s[0] in args.steps]
+    log_event("watcher_start", steps=[s[0] for s in wanted],
+              interval_s=args.interval_s, pid=os.getpid())
+
+    def entry(state, name):
+        return state.get(name, {"rc": None, "attempts": 0})
+
+    while True:
+        state = load_state()
+        pending = [s for s in wanted if entry(state, s[0])["rc"] != 0
+                   and entry(state, s[0])["attempts"] < MAX_ATTEMPTS]
+        if not pending:
+            gave_up = [s[0] for s in wanted if entry(state, s[0])["rc"] != 0]
+            log_event("watcher_done",
+                      captured=[s[0] for s in wanted
+                                if entry(state, s[0])["rc"] == 0],
+                      gave_up=gave_up)
+            return 0 if not gave_up else 1
+        up = tpu_backend_reachable(timeout_s=args.probe_timeout_s)
+        if not up:
+            if args.once:
+                log_event("probe_down_once_exit")
+                return 1
+            time.sleep(args.interval_s)
+            continue
+        log_event("relay_up", pending=[s[0] for s in pending])
+        for name, argv, deadline, tpu_proofs in pending:
+            ok = run_step(name, argv, deadline, tpu_proofs)
+            state = load_state()
+            e = entry(state, name)
+            state[name] = {"rc": 0 if ok else 1,
+                           "attempts": e["attempts"] + 1,
+                           "at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())}
+            save_state(state)
+            if not ok and not tpu_backend_reachable(
+                    timeout_s=args.probe_timeout_s):
+                # the relay died mid-step — that attempt is on the relay,
+                # not the step: refund it and go back to waiting
+                log_event("relay_lost_mid_sequence", failed_step=name)
+                state[name]["attempts"] = e["attempts"]
+                save_state(state)
+                break
+        if args.once:
+            # exit code must tell the truth about the capture, matching
+            # the probe-down path's rc 1: anything still pending failed
+            state = load_state()
+            missed = [s[0] for s in wanted if entry(state, s[0])["rc"] != 0]
+            return 1 if missed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

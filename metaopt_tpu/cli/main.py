@@ -698,6 +698,7 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
         "completed_by_worker": sum(st.completed for st in all_stats),
         "broken_by_worker": sum(st.broken for st in all_stats),
         "pruned_by_worker": sum(st.pruned for st in all_stats),
+        "requeued_by_worker": sum(st.requeued for st in all_stats),
         "producer_timings": timings,
         "total": s["by_status"],
         "best": s["best"],

@@ -265,3 +265,89 @@ def tpu_backend_reachable(timeout_s: float = 90.0) -> bool:
         [sys.executable, "-c", code], timeout_s=timeout_s, poll_s=1.0
     )
     return rc == 0
+
+
+def kill_by_env_marker(marker: str) -> int:
+    """SIGKILL every process whose environment carries ``marker``.
+
+    Deep process trees here use ``start_new_session`` at several levels
+    (executor trials, bench children), so neither killing a parent nor its
+    process group reaches them — but they all inherit the launcher's env.
+    Sweeping /proc by a unique marker reaps the whole tree, freeing the
+    single-slot relay for whoever runs next. Used by benchmarks/run.py on
+    config timeouts and benchmarks/watch_tpu.py on step deadlines.
+    """
+    import signal as _signal
+
+    me = os.getpid()
+    killed = 0
+    try:
+        pids = os.listdir("/proc")
+    except OSError:  # non-Linux host: nothing to sweep, don't sink the run
+        return 0
+    for pid_s in pids:
+        if not pid_s.isdigit() or int(pid_s) == me:
+            continue
+        try:
+            with open(f"/proc/{pid_s}/environ", "rb") as f:
+                if marker.encode() not in f.read():
+                    continue
+            os.kill(int(pid_s), _signal.SIGKILL)
+            killed += 1
+        except (OSError, PermissionError):
+            continue
+    return killed
+
+
+def run_swept(
+    argv: Sequence[str],
+    timeout_s: float,
+    env: Optional[dict] = None,
+    marker: Optional[str] = None,
+    cwd: Optional[str] = None,
+) -> Tuple[Optional[int], str, str]:
+    """Run ``argv`` in its own session; on deadline, reap its WHOLE tree.
+
+    The child gets a unique ``MTPU_SWEEP_MARKER`` in its env. If the
+    deadline fires, the direct kill is followed by :func:`kill_by_env_marker`
+    — descendants that ``start_new_session`` (executor trials, bench
+    children) escape any killpg but inherit the env, and an orphan holding
+    the single-slot relay wedges everyone after us. Returns
+    ``(rc_or_None, stdout, stderr)``; rc None = deadline.
+    """
+    env = dict(env if env is not None else os.environ)
+    marker = marker or f"sweep-{os.getpid()}-{time.time_ns()}"
+    # ACCUMULATE markers across nesting (watch_tpu → run.py → trials):
+    # overwriting would strip the outer caller's marker from the whole
+    # subtree, leaving its deadline sweep nothing to match. Matching is
+    # substring-based, so a comma-joined list serves every level
+    prev = env.get("MTPU_SWEEP_MARKER")
+    env["MTPU_SWEEP_MARKER"] = f"{prev},{marker}" if prev else marker
+    # temp files, never PIPE (module doctrine): an orphan that survives the
+    # marker sweep keeps a pipe's write end open and communicate() would
+    # discard everything the dead child DID print — exactly the wedge
+    # diagnostics this helper exists to preserve
+    with tempfile.TemporaryFile() as out_f, tempfile.TemporaryFile() as err_f:
+        proc = subprocess.Popen(
+            list(argv), env=env, cwd=cwd,
+            stdout=out_f, stderr=err_f, start_new_session=True,
+        )
+        try:
+            rc: Optional[int] = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            kill_by_env_marker(marker)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # unreapable; the flushed temp files still read fine
+            rc = None
+
+        def _read(f) -> str:
+            # pread, never seek: an orphan surviving the sweep still
+            # shares the file description, and moving its offset would
+            # let its next write corrupt the captured bytes
+            data, _ = _drain_fd(f.fileno(), 0)
+            return data.decode("utf-8", "replace")
+
+        return rc, _read(out_f), _read(err_f)
