@@ -70,7 +70,11 @@ STEPS = (
     ("smoke",
      [sys.executable, os.path.join(REPO, "benchmarks", "run.py"),
       "--scale", "smoke", "--backend", "tpu", "--save"],
-     9000.0, ('"backend_observed": "tpu"',)),
+     # above run.py's own worst case (4 configs × 1800s + evolution_ppo's
+     # 2× timeout_scale = 10800s, + the post-sweep probe): the outer
+     # deadline exists for a WEDGED sweep, and must never kill a healthy
+     # one that is still inside its per-config caps
+     12600.0, ('"backend_observed": "tpu"',)),
 )
 
 
