@@ -288,7 +288,8 @@ class CoordServer:
             except OSError:
                 return  # socket closed by stop()
             t = threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="coord-conn",  # leak-attributable (tests/conftest.py)
             )
             t.start()
 

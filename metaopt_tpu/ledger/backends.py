@@ -271,7 +271,10 @@ class MemoryLedger(LedgerBackend):
 
     def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
         with self._lock:
-            new_ids = self._index(experiment).get("new")
+            # .get, not _index(): read paths must not resurrect entries
+            # for deleted/unknown experiment names (monotonic map growth
+            # on a long-lived coordinator with experiment churn)
+            new_ids = self._status_ids.get(experiment, {}).get("new")
             if not new_ids:
                 return None
             exp = self._trials[experiment]
@@ -329,7 +332,7 @@ class MemoryLedger(LedgerBackend):
             if statuses is None:
                 picked = exp.values()
             else:  # index: touch only matching trials, not the whole table
-                idx = self._index(experiment)
+                idx = self._status_ids.get(experiment, {})
                 ids = set().union(*(idx.get(s, set()) for s in statuses)) \
                     if statuses else set()
                 picked = (exp[i] for i in ids if i in exp)
@@ -344,7 +347,7 @@ class MemoryLedger(LedgerBackend):
         with self._lock:
             if statuses is None:
                 return len(self._trials.get(experiment, {}))
-            idx = self._index(experiment)
+            idx = self._status_ids.get(experiment, {})
             return sum(len(idx.get(s, ())) for s in statuses)
 
     def export_docs(self, experiment: str) -> List[Dict[str, Any]]:
