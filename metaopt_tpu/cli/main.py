@@ -1673,3 +1673,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     except KeyboardInterrupt:
         print("interrupted", file=sys.stderr)
         return 130
+    except BrokenPipeError:
+        # `mtpu status | head` closing stdout early is not an error; die
+        # quietly the way POSIX tools do (devnull swap: the interpreter
+        # would otherwise warn while flushing the dead stdout at exit)
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0

@@ -42,6 +42,16 @@ _ERRORS = {
 }
 
 
+class CoordUnavailableError(ConnectionError):
+    """The coordinator stayed unreachable through the reconnect window.
+
+    Deliberately NOT a bare ``BrokenPipeError``/``OSError`` escape: the CLI
+    treats ``BrokenPipeError`` as "stdout pipe closed, exit quietly", and a
+    dead coordinator must never masquerade as that (exit 0 on a hard
+    infrastructure failure).
+    """
+
+
 class CoordRPCError(RuntimeError):
     """Server-side failure that doesn't map to a known ledger exception."""
 
@@ -110,12 +120,16 @@ class CoordLedgerClient(LedgerBackend):
                     raise ConnectionError("coordinator closed the connection")
                 break
             except (ConnectionError, BrokenPipeError, OSError,
-                    ProtocolError):  # incl. a reply frame cut by shutdown
+                    ProtocolError) as err:  # incl. a frame cut by shutdown
                 self._drop_sock()
                 attempt += 1
                 if attempt >= 2:
                     if time.monotonic() >= deadline:
-                        raise
+                        raise CoordUnavailableError(
+                            f"coordinator {self.host}:{self.port} "
+                            f"unreachable for {self.reconnect_window_s:.0f}s"
+                            f" ({type(err).__name__}: {err})"
+                        ) from err
                     time.sleep(0.25)  # coordinator down; wait out the restart
         if reply["ok"]:
             return reply["result"]
