@@ -1,0 +1,90 @@
+"""The complete device-wedge failure lifecycle, end to end.
+
+SURVEY.md §5 failure detection, closed as one story: a permanently dead
+backend burns a trial's shared requeue budget and converges to
+terminal-interrupted with the worker stopped (never max_broken, never an
+infinite requeue grind); ``mtpu resume`` — the exact remedy the worker's
+stop message names — revives the parked trials with a FRESH budget
+(reset_to_new clears resources); and once the device answers again the
+same experiment runs to completion on the same ledger.
+"""
+
+import tempfile
+
+import pytest
+
+from metaopt_tpu.cli import main as cli_main
+from metaopt_tpu.executor.base import ExecutionResult
+from metaopt_tpu.executor.subproc import SubprocessExecutor
+from metaopt_tpu.executor.tpu import TPUExecutor
+from metaopt_tpu.ledger.backends import make_ledger
+from metaopt_tpu.ledger.experiment import Experiment
+from metaopt_tpu.space.builder import SpaceBuilder
+from metaopt_tpu.worker.loop import workon
+
+
+@pytest.fixture()
+def wedge_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("MTPU_SLICE_CHIPS", "4")
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    # the conftest forces JAX_PLATFORMS=cpu, which correctly DISARMS the
+    # breaker; this test simulates a relay-attached environment
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+
+
+def make_exp(led_path):
+    ledger = make_ledger({"type": "file", "path": led_path})
+    space, template = SpaceBuilder().build(["t.py", "-x~uniform(0, 1)"])
+    exp = Experiment(
+        "wedgecycle", ledger,
+        space=space, max_trials=3, algorithm={"random": {"seed": 0}},
+    ).configure()
+    return exp, template
+
+
+def test_wedge_to_resume_to_completion(wedge_env, tmp_path, monkeypatch):
+    led = str(tmp_path / "led")
+    exp, template = make_exp(led)
+
+    # --- phase 1: the backend is dead forever ---------------------------
+    dead = TPUExecutor(template, n_chips=1, probe_fn=lambda **_: False,
+                       park_poll_s=0.01, park_max_s=0.02)
+    monkeypatch.setattr(
+        SubprocessExecutor, "_execute_inner",
+        lambda self, t, heartbeat=None, judge=None: ExecutionResult(
+            "broken", note="timeout after 1.0s"),
+    )
+    stats = workon(exp, dead, worker_id="w0", max_broken=50,
+                   max_idle_cycles=30)
+    assert stats.broken == 0, "wedge breakage must never count as broken"
+    assert stats.requeued == 3, "the shared budget binds at max_requeues"
+    assert stats.interrupted == 1, "then the trial goes terminal"
+    parked = exp.ledger.fetch("wedgecycle", "interrupted")
+    assert len(parked) == 1
+    assert int(parked[0].resources.get("requeues", 0)) == 3
+
+    # --- phase 2: the operator follows the stop message ------------------
+    rc = cli_main(["resume", "-n", "wedgecycle", "--ledger", led,
+                   "--statuses", "interrupted"])
+    assert rc == 0
+    revived = exp.ledger.fetch("wedgecycle", "new")
+    assert any(t.id == parked[0].id for t in revived)
+    # reset_to_new cleared the residue: fresh budget, no stale chip pin
+    assert all(t.resources == {} for t in revived if t.id == parked[0].id)
+
+    # --- phase 3: the device is back -------------------------------------
+    exp2, _ = make_exp(led)  # adopt, as a fresh `mtpu hunt` would
+    alive = TPUExecutor(template, n_chips=1, probe_fn=lambda **_: True)
+    monkeypatch.setattr(
+        SubprocessExecutor, "_execute_inner",
+        lambda self, t, heartbeat=None, judge=None: ExecutionResult(
+            "completed", results=[{"name": "o", "type": "objective",
+                                   "value": 1.0}]),
+    )
+    stats2 = workon(exp2, alive, worker_id="w1", max_broken=3)
+    assert stats2.broken == 0
+    done = exp2.ledger.fetch("wedgecycle", "completed")
+    assert len(done) == 3, "the SAME experiment completes on the same ledger"
+    assert any(t.id == parked[0].id for t in done), \
+        "the revived trial itself ran to completion"

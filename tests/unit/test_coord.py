@@ -543,3 +543,36 @@ class TestDeleteExperiment:
             c2 = _client(s2)
             assert c2.load_experiment("exp") is None
             assert c2.fetch("exp") == []
+
+
+class TestUnavailableContract:
+    def test_dead_coordinator_raises_typed_error(self):
+        """A coordinator that never answers surfaces as
+        CoordUnavailableError — NOT a bare BrokenPipeError/OSError: the
+        CLI treats BrokenPipeError as "stdout pipe closed, exit 0", and a
+        hard infrastructure failure must never exit 0."""
+        import socket as _socket
+
+        from metaopt_tpu.coord.client_backend import (
+            CoordLedgerClient,
+            CoordUnavailableError,
+        )
+
+        # hold the port bound WITHOUT listen() for the whole test:
+        # connects get a deterministic ECONNREFUSED and no other process
+        # can claim the port in between (a bind-then-close probe would
+        # leave a TOCTOU window where a foreign listener turns this into
+        # an indefinite recv hang instead of a refusal)
+        anchor = _socket.socket()
+        try:
+            anchor.bind(("127.0.0.1", 0))
+            port = anchor.getsockname()[1]
+            c = CoordLedgerClient(host="127.0.0.1", port=port,
+                                  connect_timeout_s=0.2,
+                                  reconnect_window_s=0.5)
+            with pytest.raises(CoordUnavailableError) as err:
+                c.ping()
+        finally:
+            anchor.close()
+        assert not isinstance(err.value, BrokenPipeError)
+        assert "unreachable" in str(err.value)
