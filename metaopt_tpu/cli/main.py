@@ -190,7 +190,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "release) against the configured ledger; "
                          "rm: delete an experiment and its trials; "
                          "compact: rewrite a native ledger's append-only "
-                         "log to its live state (reclaims heartbeat spam); "
+                         "log to its live state (reclaims heartbeat spam), "
+                         "or fold a file ledger's index log into its "
+                         "snapshot; "
                          "dump: archive experiments + trials to portable "
                          "JSON; load: restore an archive into the "
                          "configured ledger; "
@@ -1387,8 +1389,9 @@ def _cmd_db(args, cfg: Dict[str, Any]) -> int:
     if args.action == "compact":
         if not hasattr(ledger, "compact"):
             raise SystemExit(
-                f"backend {type(ledger).__name__} has no compaction (only "
-                "the native ledgerstore appends an ever-growing log)"
+                f"backend {type(ledger).__name__} has no compaction "
+                "(native and file ledgers keep append-only logs; memory "
+                "and coord stores have nothing on disk to fold)"
             )
         names = ([args.name] if args.name
                  else sorted(ledger.list_experiments()))

@@ -394,3 +394,53 @@ class TestNativeWipeReplay:
         a.register(ghost)
         a.create_experiment({"name": "g", "max_trials": 5})
         assert a.fetch("g") == []
+
+
+class TestFileCompaction:
+    def test_compact_folds_log_and_preserves_cursors(self, tmp_path):
+        """Explicit compaction (`mtpu db compact` path): the index log is
+        folded into the snapshot, bytes reclaimed are reported, and —
+        the contract that matters — the epoch survives, so a held
+        fetch_completed_since cursor keeps working incrementally instead
+        of forcing a full refetch."""
+        from metaopt_tpu.ledger.backends import FileLedger
+        from metaopt_tpu.ledger.trial import Trial
+
+        led = FileLedger(path=str(tmp_path / "led"))
+        led.create_experiment({"name": "c"})
+
+        def completed(x):
+            t = Trial(params={"x": x}, experiment="c")
+            led.register(t)
+            got = led.reserve("c", "w")
+            got.transition("completed")
+            got.attach_results(
+                [{"name": "o", "type": "objective", "value": x}]
+            )
+            assert led.update_trial(got, expected_status="reserved")
+            return got
+
+        first = [completed(float(i)) for i in range(5)]
+        seen, cur = led.fetch_completed_since("c")
+        assert len(seen) == 5
+
+        freed = led.compact("c")
+        assert freed > 0, "the accumulated log had bytes to reclaim"
+        import os
+        assert not os.path.exists(led._lpath("c"))
+
+        # cursor minted BEFORE compaction still advances incrementally
+        later = completed(99.0)
+        new, cur2 = led.fetch_completed_since("c", cur)
+        assert [t.id for t in new] == [later.id], \
+            "same epoch: only the post-compaction completion is returned"
+        # statuses and the queue survived: a fresh trial still reserves
+        led.register(Trial(params={"x": 123.0}, experiment="c"))
+        assert led.reserve("c", "w2") is not None
+        assert led.count("c", "completed") == 6
+
+    def test_compact_unknown_experiment_is_zero(self, tmp_path):
+        from metaopt_tpu.ledger.backends import FileLedger
+
+        led = FileLedger(path=str(tmp_path / "led"))
+        assert led.compact("nope") == 0
