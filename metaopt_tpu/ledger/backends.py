@@ -758,6 +758,11 @@ class FileLedger(LedgerBackend):
             log_size = os.stat(self._lpath(experiment)).st_size
         except OSError:
             log_size = 0
+        if log_size == 0:
+            # nothing to fold: do NOT rewrite the snapshot — that would
+            # bump its mtime and cache-bust every other process's parsed
+            # index for zero reclaimed bytes
+            return 0
         idx["new_queue"] = [
             e for e in idx["new_queue"]
             if idx["statuses"].get(e[1]) == "new"

@@ -2,10 +2,11 @@
 
 ref: src/metaopt/core/worker/__init__.py (SURVEY.md §2.1): produce → reserve
 → consume until the experiment is done; KeyboardInterrupt marks the in-flight
-trial interrupted. Additions over the reference: stale-reservation release
-each cycle (pacemaker doctrine), per-worker trial caps (``worker_trials``),
-idle backoff when the algorithm is barrier-blocked (Hyperband rung waits),
-and the judge/early-stop wiring into the executor.
+trial interrupted. Additions over the reference: throttled stale-reservation
+release (pacemaker doctrine — every ``stale_sweep_interval_s``, and always
+on the first cycle), per-worker trial caps (``worker_trials``), idle backoff
+when the algorithm is barrier-blocked (Hyperband rung waits), and the
+judge/early-stop wiring into the executor.
 """
 
 from __future__ import annotations
@@ -53,6 +54,7 @@ def workon(
     max_idle_cycles: int = 200,
     producer_mode: str = "local",
     stop_event: Optional[Any] = None,
+    stale_sweep_interval_s: float = 2.0,
 ) -> WorkerStats:
     """Run trials until the experiment finishes (or this worker's cap hits).
 
@@ -67,6 +69,12 @@ def workon(
     ``stop_event`` (a ``threading.Event``-like): checked between trials —
     how `hunt --n-workers` winds its worker threads down cleanly on Ctrl-C
     (the in-flight trial finishes, the executor closes).
+
+    ``stale_sweep_interval_s``: how often this worker sweeps lapsed
+    reservations back to ``new``. A stale reservation is already
+    ``heartbeat_timeout_s`` old by definition, so per-cycle sweeping buys
+    nothing and costs an RPC/lock round-trip per cycle; the first cycle
+    always sweeps (a restart must free its dead predecessor's holds).
     """
     algo: Optional[BaseAlgorithm]
     if producer_mode == "coord":
@@ -84,6 +92,9 @@ def workon(
     # The count persists on the trial document (resources), so N workers
     # (or a restarted worker) share ONE budget instead of multiplying it.
     max_requeues = 3
+    # first loop iteration always sweeps (resuming after a crash must
+    # free the dead predecessor's reservations before producing)
+    last_sweep = 0.0
 
     def heartbeat_for(trial: Trial):
         def beat() -> bool:
@@ -107,7 +118,16 @@ def workon(
             )
             break
 
-        experiment.ledger.release_stale(experiment.name, heartbeat_timeout_s)
+        # pacemaker duty, throttled: a stale reservation is minutes old by
+        # definition (heartbeat_timeout_s), so sweeping every cycle buys
+        # nothing and costs an RPC/lock round-trip per cycle — on the
+        # coord backend that was one of ~5 RPCs per trial
+        now = time.time()
+        if now - last_sweep >= stale_sweep_interval_s:
+            experiment.ledger.release_stale(
+                experiment.name, heartbeat_timeout_s
+            )
+            last_sweep = now
         produced = producer.produce()
         trial = experiment.reserve_trial(worker_id)
 
