@@ -219,3 +219,40 @@ class TestWorkon:
         best = exp.stats["best"]
         assert best["objective"] < 0.05
         assert abs(best["params"]["x"] - 1.0) < 0.25
+
+
+class TestStaleSweepThrottle:
+    def test_first_cycle_sweeps_then_throttles(self):
+        """The pacemaker sweep runs on cycle one (a restarted worker must
+        free its dead predecessor's holds before producing) and then at
+        most every stale_sweep_interval_s — not per cycle."""
+        from metaopt_tpu.executor import InProcessExecutor
+        from metaopt_tpu.ledger.backends import make_ledger
+        from metaopt_tpu.ledger.experiment import Experiment
+        from metaopt_tpu.space import build_space
+        from metaopt_tpu.worker import workon
+
+        ledger = make_ledger({"type": "memory"})
+        calls = {"n": 0}
+        orig = ledger.release_stale
+
+        def counting(name, timeout_s):
+            calls["n"] += 1
+            return orig(name, timeout_s)
+
+        ledger.release_stale = counting
+        exp = Experiment(
+            "throttle", ledger,
+            space=build_space({"x": "uniform(0, 1)"}),
+            max_trials=20, algorithm={"random": {"seed": 0}},
+        ).configure()
+        stats = workon(
+            exp,
+            InProcessExecutor(lambda p: [{
+                "name": "o", "type": "objective", "value": p["x"]}]),
+            worker_id="w0",
+            stale_sweep_interval_s=3600.0,  # only the first cycle sweeps
+        )
+        assert stats.completed == 20
+        assert calls["n"] == 1, \
+            "one sweep for the whole hunt at a huge interval"
