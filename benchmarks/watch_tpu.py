@@ -49,6 +49,11 @@ LOG = os.path.join(RESULTS, "watch_log.jsonl")
 #: not be retried forever — give up after this many attempts and say so
 MAX_ATTEMPTS = 4
 
+#: a capture checkpoint older than this is stale: watch_state.json
+#: persists across build rounds, and a fresh round's watcher must not
+#: treat LAST round's capture as this round's (use --reset to force)
+MAX_STATE_AGE_H = 24.0
+
 #: capture sequence: (name, argv, deadline_s, tpu_proofs). Ordered by
 #: value-per-minute — the bench record is what the driver parses, so it
 #: goes first; the smoke is the longest and most interruption-tolerant, so
@@ -151,6 +156,28 @@ def main() -> int:
     wanted = [s for s in STEPS if args.steps is None or s[0] in args.steps]
     log_event("watcher_start", steps=[s[0] for s in wanted],
               interval_s=args.interval_s, pid=os.getpid())
+
+    # checkpoint staleness is judged ONCE, against watcher start: a prior
+    # round's capture must not satisfy this round, but a single long
+    # session must never expire its OWN checkpoints mid-run (that would
+    # re-burn the next relay window on steps already captured, and reset
+    # a failing step's attempts under the MAX_ATTEMPTS bound)
+    import calendar
+
+    state0 = load_state()
+    expired = []
+    for name, e in list(state0.items()):
+        try:
+            at_s = calendar.timegm(
+                time.strptime(e.get("at", ""), "%Y-%m-%dT%H:%M:%SZ"))
+        except ValueError:
+            continue  # only the watcher writes 'at'; keep odd entries
+        if time.time() - at_s > MAX_STATE_AGE_H * 3600:
+            expired.append(name)
+            del state0[name]
+    if expired:
+        save_state(state0)
+        log_event("stale_checkpoints_expired", steps=expired)
 
     def entry(state, name):
         return state.get(name, {"rc": None, "attempts": 0})

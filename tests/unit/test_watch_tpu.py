@@ -151,3 +151,30 @@ class TestDeadline:
         events = [json.loads(l) for l in open(watch.LOG)]
         end = [e for e in events if e["event"] == "step_end"][-1]
         assert end["rc"] == "timeout" and end["on_tpu"] is False
+
+
+class TestStateStaleness:
+    def test_old_checkpoints_expire(self, watch, monkeypatch):
+        """watch_state.json persists across build rounds: a checkpoint
+        from yesterday's capture must not satisfy today's round."""
+        monkeypatch.setattr(watch, "STEPS", (
+            fake_step("bench", '{"backend": "tpu", "stage_errors": 0}',
+                      proofs=('"backend": "tpu"', '"stage_errors": 0')),
+        ))
+        import time as _t
+        old = _t.strftime("%Y-%m-%dT%H:%M:%SZ",
+                          _t.gmtime(_t.time() - 48 * 3600))
+        watch.save_state({"bench": {"rc": 0, "attempts": 1, "at": old}})
+        assert run_once(watch, monkeypatch) == 0
+        log = open(watch.LOG).read()
+        assert '"step": "bench"' in log, "the stale capture re-ran"
+
+    def test_fresh_checkpoints_hold(self, watch, monkeypatch):
+        monkeypatch.setattr(watch, "STEPS", (
+            fake_step("bench", "SHOULD-NOT-RUN"),
+        ))
+        import time as _t
+        now = _t.strftime("%Y-%m-%dT%H:%M:%SZ", _t.gmtime())
+        watch.save_state({"bench": {"rc": 0, "attempts": 1, "at": now}})
+        assert run_once(watch, monkeypatch) == 0
+        assert "SHOULD-NOT-RUN" not in open(watch.LOG).read()
