@@ -251,6 +251,14 @@ class Transformer(nn.Module):
             (self.max_len, self.d_model),
         )
         s_len, t_len = src.shape[1], tgt_in.shape[1]
+        if max(s_len, t_len) > self.max_len:
+            # shapes are static under jit, so this fires at trace time with
+            # a readable message instead of a broadcast error deep in XLA
+            raise ValueError(
+                f"sequence length {max(s_len, t_len)} exceeds the positional "
+                f"table (max_len={self.max_len}); pass max_len>=seq to "
+                f"make_model"
+            )
         src_pad = (src != 0)[:, None, None, :]                    # (b,1,1,k)
         causal = jnp.tril(jnp.ones((t_len, t_len), bool))[None, None]
         tgt_pad = (tgt_in != 0)[:, None, None, :]
@@ -305,6 +313,7 @@ def make_model(hparams: Optional[Dict[str, Any]] = None, **overrides) -> Transfo
         n_layers=int(h.get("n_layers", 6)),
         d_ff=int(h.get("d_ff", 2048)),
         dropout=float(h.get("dropout", 0.1)),
+        max_len=int(h.get("max_len", 512)),
         n_experts=int(h.get("n_experts", 0)),
         capacity_factor=float(h.get("capacity_factor", 1.25)),
         router_top_k=int(h.get("router_top_k", 1)),
@@ -312,16 +321,40 @@ def make_model(hparams: Optional[Dict[str, Any]] = None, **overrides) -> Transfo
     )
 
 
-#: vocab size above which loss_fn switches to the blocked xent: below it
-#: the (B, T, V) tensor is small and the plain optax path is simpler/faster
-_BLOCKED_XENT_MIN_VOCAB = 8192
+#: materialized f32 (B, T, V) logits size above which loss_fn switches to
+#: the blocked xent. Below it the plain optax path is simpler AND faster:
+#: measured on the v5e (bench 2026-08-01, vocab 32000) the 2.1 GB flagship
+#: tensor fits HBM comfortably and materializing beats blocked 58.5 vs
+#: 65.3 ms/step at seq256 (parity at seq512) — the blocked path only pays
+#: for itself once the tensor genuinely threatens HBM capacity
+_BLOCKED_XENT_MIN_LOGITS_BYTES = 4 << 30
+
+
+def blocked_xent_enabled(batch: int, seq: int, vocab: int) -> bool:
+    """True when :func:`loss_fn` folds the readout into the blocked xent.
+
+    Gates on the PER-DEVICE materialized f32 logits size: on a parallel
+    mesh the batch dims are sharded over dp/sp, so HBM pressure is
+    ``global_bytes / batch_shards``, not the global tensor. bench.py labels
+    its records with this same predicate — keep them in sync by calling it,
+    not copying it.
+    """
+    from metaopt_tpu.parallel.mesh import active_mesh
+
+    shards = 1
+    mesh = active_mesh()
+    if mesh is not None:
+        shape = dict(mesh.shape)
+        shards = shape.get("dp", 1) * shape.get("sp", 1)
+    per_device = 4 * batch * seq * vocab // max(shards, 1)
+    return per_device >= _BLOCKED_XENT_MIN_LOGITS_BYTES
 
 
 def loss_fn(model, params, batch, dropout_key, moe_aux_weight: float = 0.01):
     src, tgt = batch
     bos = jnp.ones((tgt.shape[0], 1), tgt.dtype)
     tgt_in = jnp.concatenate([bos, tgt[:, :-1]], axis=1)
-    blocked = model.vocab >= _BLOCKED_XENT_MIN_VOCAB
+    blocked = blocked_xent_enabled(tgt.shape[0], tgt.shape[1], model.vocab)
     out, mutated = model.apply(
         {"params": params}, src, tgt_in, train=True, features=blocked,
         rngs={"dropout": dropout_key},
@@ -329,9 +362,9 @@ def loss_fn(model, params, batch, dropout_key, moe_aux_weight: float = 0.01):
     )
     mask = (tgt != 0).astype(jnp.float32)
     if blocked:
-        # large vocab: fold the tied readout into a blocked online-softmax
-        # xent (ops/xent.py) — the f32 (B, T, V) logits tensor (2.1 GB at
-        # the flagship bench shape) never exists in HBM
+        # HBM-threatening logits: fold the tied readout into a blocked
+        # online-softmax xent (ops/xent.py) — the f32 (B, T, V) tensor
+        # never exists in HBM
         from metaopt_tpu.ops.xent import blocked_softmax_xent, pick_block_v
 
         emb = params["embed"]["embedding"]

@@ -182,6 +182,26 @@ class TestTransformer:
         q = params["enc0"]["self_attn"]["q"]["kernel"]
         assert nn.meta.unbox(q).sharding.spec == P(None, "tp", None)
 
+    def test_max_len_forwarded_and_overflow_is_loud(self):
+        """make_model must forward max_len (the 2026-08-01 TPU bench lost
+        its seq-1024 stages to the 512 default), and a sequence longer than
+        the positional table must raise at trace time, not as an XLA
+        broadcast error."""
+        import jax
+        import jax.numpy as jnp
+        import pytest
+        from metaopt_tpu.models.transformer import make_model
+
+        h = {"d_model": 32, "n_heads": 2, "n_layers": 1, "d_ff": 64,
+             "vocab": 50, "dropout": 0.0}
+        short = make_model(h)  # default table: 512
+        src = jnp.ones((2, 513), jnp.int32)
+        with pytest.raises(ValueError, match="max_len"):
+            short.init(jax.random.PRNGKey(0), src, src, train=False)
+        long = make_model({**h, "max_len": 1024})
+        assert long.max_len == 1024
+        long.init(jax.random.PRNGKey(0), src, src, train=False)
+
 
 class TestPPO:
     def test_ppo_improves_return(self):

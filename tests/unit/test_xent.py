@@ -87,11 +87,25 @@ class TestLossFnRouting:
         params = model.init(jax.random.PRNGKey(1), src, src,
                             train=False)["params"]
 
-        monkeypatch.setattr(tf, "_BLOCKED_XENT_MIN_VOCAB", 1 << 30)
+        monkeypatch.setattr(tf, "_BLOCKED_XENT_MIN_LOGITS_BYTES", 1 << 62)
         dense = tf.loss_fn(model, params, (src, tgt), jax.random.PRNGKey(2))
-        monkeypatch.setattr(tf, "_BLOCKED_XENT_MIN_VOCAB", 1)
+        monkeypatch.setattr(tf, "_BLOCKED_XENT_MIN_LOGITS_BYTES", 1)
         blocked = tf.loss_fn(model, params, (src, tgt), jax.random.PRNGKey(2))
         # the dense path rounds logits to bf16 before the f32 xent; the
         # blocked path accumulates the same bf16 operands straight into
         # f32 — equal to bf16 rounding noise
         assert abs(float(dense) - float(blocked)) < 0.05
+
+    def test_gate_is_per_device_bytes(self, monkeypatch):
+        """HBM pressure is per chip: a dp/sp mesh shards the batch dims,
+        so the same global shape must route materializing on 8 chips where
+        it routes blocked on 1."""
+        import metaopt_tpu.models.transformer as tf
+        from metaopt_tpu.parallel import make_mesh
+        from metaopt_tpu.parallel.mesh import use_mesh
+
+        monkeypatch.setattr(tf, "_BLOCKED_XENT_MIN_LOGITS_BYTES",
+                            4 * 64 * 16 * 1000)
+        assert tf.blocked_xent_enabled(64, 16, 1000)
+        with use_mesh(make_mesh([("dp", 4), ("sp", 2)])):
+            assert not tf.blocked_xent_enabled(64, 16, 1000)
