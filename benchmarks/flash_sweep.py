@@ -2,9 +2,12 @@
 
 VERDICT r3 #4: the Pallas kernel tied the chunked twin at seq 256 and was
 never measured where flash matters. This sweep times forward and full-grad
-steps for both impls at seq 256→4096 (causal-masked and unmasked), over a
-small grid of (block_q, block_k), and records per-seq ratios plus the
-crossover — the data that decides attention_impl()'s TPU default.
+steps for both impls at seq 4096→256 (descending — the crossover data
+first, because relay windows die without warning), causal-masked by
+default, over a small grid of (block_q, block_k), and records per-seq
+ratios plus the crossover — the data that decides attention_impl()'s TPU
+default. ``--unmasked`` adds the unmasked study, ``--grid`` the full
+block grid.
 
 Run on the real chip (no JAX_PLATFORMS override):
     python benchmarks/flash_sweep.py [--save] [--quick]
@@ -43,16 +46,43 @@ def main() -> None:
     import jax.numpy as jnp
 
     from metaopt_tpu.ops.attention import flash_attention
+    from metaopt_tpu.utils.provenance import provenance
 
     if jax.default_backend() != "tpu":
         print(json.dumps({"error": "not on tpu; sweep is meaningless"}))
         return
 
-    seqs = (256, 1024, 2048) if quick else (256, 512, 1024, 2048, 4096)
+    # Decision data first: the 2026-08-01 window died after 75 minutes of
+    # seq-256 block shapes — the crossover question lives at seq >= 1024,
+    # so sweep DESCENDING, causal-only by default (the transformer training
+    # path), with the block grid trimmed to the shapes that have ever won.
+    # --unmasked / --grid restore the full study when a window is long.
+    seqs = (2048, 1024, 256) if quick else (4096, 2048, 1024, 512, 256)
     blocks = ((128, 128), (256, 256)) if quick else (
-        (128, 128), (128, 256), (256, 128), (256, 256), (128, 512),
-        (256, 512),
-    )
+        (128, 128), (256, 256), (128, 256))
+    if "--grid" in sys.argv:
+        blocks = blocks + ((256, 128), (128, 512), (256, 512))
+    maskeds = (True, False) if "--unmasked" in sys.argv else (True,)
+    save_path = None
+    # run id: appended-to files can hold a partial run plus its same-day
+    # retry — rows group by this, so consumers never double-count
+    stamp_now = provenance(backend=jax.default_backend(),
+                           run=f"{int(time.time())}-{os.getpid()}")
+    if save:
+        stamp = time.strftime("%Y-%m-%d", time.gmtime())
+        save_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "results",
+            f"flash_sweep_{stamp}.jsonl")
+
+    def emit(row) -> None:
+        # append to disk the moment a row exists: a relay death mid-sweep
+        # (the 2026-08-01 failure mode, "Connection refused" at minute 75)
+        # must not take the already-measured rows with it
+        print(json.dumps(row), flush=True)
+        if save_path:
+            with open(save_path, "a") as f:
+                f.write(json.dumps({**row, **stamp_now}) + "\n")
+
     h, d = 8, 64
     rows = []
     for seq in seqs:
@@ -64,7 +94,7 @@ def main() -> None:
         causal = jnp.broadcast_to(
             jnp.tril(jnp.ones((seq, seq), bool))[None], (b, seq, seq)
         )
-        for masked in (False, True):
+        for masked in maskeds:
             mask = causal if masked else None
             ref = None
             configs = [("chunked", 128, 128), ("chunked", 128, 256)]
@@ -110,12 +140,12 @@ def main() -> None:
                            "impl": impl, "block_q": bq, "block_k": bk,
                            "error": f"{type(exc).__name__}: {exc}"[:300]}
                 rows.append(row)
-                print(json.dumps(row), flush=True)
+                emit(row)
 
     # crossover: per (seq, masked), best pallas grad_ms vs best chunked
     summary = {"metric": "flash_vs_chunked", "points": []}
     for seq in seqs:
-        for masked in (False, True):
+        for masked in maskeds:
             sub = [r for r in rows if r["seq"] == seq
                    and r["masked"] == masked and "error" not in r]
             pal = [r for r in sub if r["impl"] == "pallas"]
@@ -137,24 +167,21 @@ def main() -> None:
     # masked (causal — what transformer training runs) and unmasked cross
     # at different points; one mixed number would let the unmasked case
     # flip the default where masked chunked is still faster
+    # only label studies that actually ran: crossover_seq_unmasked: None in
+    # a masked-only sweep would read as "swept, pallas never won"
     for label, want_masked in (("masked", True), ("unmasked", False)):
+        if want_masked not in maskeds:
+            continue
         wins = [p["seq"] for p in summary["points"]
                 if p["masked"] == want_masked and p["speedup"] >= 1.15]
         summary[f"crossover_seq_{label}"] = min(wins) if wins else None
-    from metaopt_tpu.utils.provenance import provenance
-
-    stamp_fields = provenance(backend=jax.default_backend())
-    summary.update(stamp_fields)
+    summary.update(stamp_now)
     print(json.dumps(summary), flush=True)
-    if save:
-        stamp = time.strftime("%Y-%m-%d", time.gmtime())
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "results", f"flash_sweep_{stamp}.jsonl")
-        with open(path, "w") as f:
-            for r in rows:
-                f.write(json.dumps({**r, **stamp_fields}) + "\n")
+    if save_path:
+        # rows were appended as they were measured; only the summary is new
+        with open(save_path, "a") as f:
             f.write(json.dumps(summary) + "\n")
-        print(f"saved: {path}", flush=True)
+        print(f"saved: {save_path}", flush=True)
 
 
 if __name__ == "__main__":

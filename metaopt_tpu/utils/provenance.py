@@ -45,12 +45,17 @@ def git_commit(repo: Optional[str] = None) -> str:
         return "unknown"
 
 
-def provenance(backend: Optional[str] = None) -> Dict[str, Any]:
-    """The stamp dict to merge into a benchmark row at write time."""
+def provenance(backend: Optional[str] = None, **extra: Any) -> Dict[str, Any]:
+    """The stamp dict to merge into a benchmark row at write time.
+
+    ``extra`` fields ride along verbatim (e.g. a ``run`` id grouping the
+    rows of one sweep attempt inside an appended-to dated file).
+    """
     stamp: Dict[str, Any] = {
         "commit": git_commit(),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     if backend is not None:
         stamp["backend"] = backend
+    stamp.update(extra)
     return stamp
