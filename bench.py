@@ -42,7 +42,7 @@ import time
 
 import numpy as np
 
-from metaopt_tpu.utils.procs import run_with_deadline
+from metaopt_tpu.utils.procs import run_with_deadline, setup_xla_cache
 
 
 def preflight_backend(timeout_s: float = 90.0, retries: int = 1) -> bool:
@@ -530,12 +530,7 @@ def main() -> None:
     # (r2 measured executable serialization working through the relay).
     # Set BEFORE the preflight: its CPU-fallback path imports jax, and jax
     # binds these env vars at import time
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".cache", "xla")
-    os.makedirs(cache, exist_ok=True)
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES", "none")
+    setup_xla_cache()
     # 3 probes over ~3.5 min: the relay wedge is sometimes transient, and a
     # TPU number in the driver's record is worth the wait — but a CPU
     # fallback run must then stay slim (TPE-only, under a minute)
