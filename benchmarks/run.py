@@ -177,9 +177,13 @@ def run_config(name: str, spec: dict, scale: str, ledger_root: str,
         "--timeout-s", "900",  # a wedged trial must not sink the sweep
         # one compile per program, not per trial: identical shapes across a
         # sweep make the persistent XLA cache the dominant trials/hour
-        # lever for short trials (single host here, so CPU AOT reuse is
-        # safe too)
-        "--jax-cache", os.path.join(ledger_root, name, "jax-cache"),
+        # lever for short trials. The REPO cache, not the sweep tempdir:
+        # remote compiles cost minutes each through the relay, and a
+        # tempdir cache went cold on every watcher attempt — the r3 smoke
+        # paid full recompiles per attempt (6.2 trials/hour on
+        # evolution_ppo). Content-addressed keys make sharing across
+        # configs/attempts/rounds safe.
+        "--jax-cache", os.path.join(REPO, ".cache", "xla"),
     ]
     if spec["config"]:
         argv += ["--config", spec["config"]]

@@ -6,8 +6,8 @@ bench windows); running the perf sweep only at end-of-round loses that race
 every time. This watcher closes VERDICT r4 missing #1: it probes the backend
 in a disposable deadline child every few minutes and, the moment the relay
 answers, runs the full capture sequence — bench.py (TPE flatness to 32k,
-MFU seq 256/512/1024, blocked-xent A/B, resnet, flash twins), the flash
-block-shape sweep, and the 5-config smoke — refreshing the committed
+MFU seq 256/512/1024, blocked-xent A/B, resnet, flash twins), the
+5-config smoke, and the flash block-shape sweep — refreshing the committed
 last-good artifacts that bench.py's CPU-fallback line rides on.
 
 Steps that complete are checkpointed in results/watch_state.json, so a relay
@@ -56,8 +56,10 @@ MAX_STATE_AGE_H = 24.0
 
 #: capture sequence: (name, argv, deadline_s, tpu_proofs). Ordered by
 #: value-per-minute — the bench record is what the driver parses, so it
-#: goes first; the smoke is the longest and most interruption-tolerant, so
-#: it goes last. EVERY string in ``tpu_proofs`` must appear in the step's
+#: goes first; the smoke (the breaker/requeue machinery proof) is second;
+#: flash_sweep goes last because it is the interruption-tolerant one: it
+#: persists each row as measured and rides the shared compile cache, so
+#: a truncated window still advances it. EVERY string in ``tpu_proofs`` must appear in the step's
 #: stdout for it to count as captured: each step's own preflight silently
 #: degrades to CPU when the relay dies between our probe and its first jax
 #: init, and a CPU artifact is not a capture. bench/flash_sweep stamp the
@@ -71,9 +73,12 @@ STEPS = (
     # profile stage's 240s = 3600s, plus the TPE section and compiles)
     ("bench", [sys.executable, os.path.join(REPO, "bench.py")],
      7200.0, ('"backend": "tpu"', '"stage_errors": 0')),
-    ("flash_sweep",
-     [sys.executable, os.path.join(REPO, "benchmarks", "flash_sweep.py"),
-      "--save"], 5400.0, ('"backend": "tpu"',)),
+    # smoke before flash: the 2026-08-01 window died with flash still
+    # compiling and the smoke never started. The smoke proves the round's
+    # headline machinery (breaker + requeue budget) live on the chip — an
+    # ask open since r3 — while flash persists rows incrementally and
+    # rides the compile cache, so it loses nothing by taking whatever is
+    # left of a window
     ("smoke",
      [sys.executable, os.path.join(REPO, "benchmarks", "run.py"),
       "--scale", "smoke", "--backend", "tpu", "--save"],
@@ -82,6 +87,9 @@ STEPS = (
      # deadline exists for a WEDGED sweep, and must never kill a healthy
      # one that is still inside its per-config caps
      12600.0, ('"backend_observed": "tpu"',)),
+    ("flash_sweep",
+     [sys.executable, os.path.join(REPO, "benchmarks", "flash_sweep.py"),
+      "--save"], 5400.0, ('"backend": "tpu"',)),
 )
 
 

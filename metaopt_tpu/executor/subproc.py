@@ -80,9 +80,12 @@ class SubprocessExecutor(Executor):
         # `hunt --jax-cache DIR`): every trial of a sweep traces the same
         # program modulo hyperparameter VALUES (shapes are static), so
         # trial N reuses trial 1's compile — the biggest trials/hour lever
-        # for short TPU trials. Opt-in because XLA:CPU caches are AOT
-        # machine code: sharing the dir across heterogeneous hosts risks
-        # SIGILL, a call the user must make.
+        # for short TPU trials. The XLA:CPU AOT sub-cache is forced OFF
+        # (same doctrine as utils/procs.setup_xla_cache): it stores
+        # host-specific machine code, and a cache dir that outlives one
+        # sweep — or is shared with the repo-wide .cache/xla — must never
+        # SIGILL a later hunt on different hardware. The jax-level
+        # executable cache alone carries the speedup.
         if jax_cache_dir:
             cache = os.path.expanduser(jax_cache_dir)
             os.makedirs(cache, exist_ok=True)
@@ -90,6 +93,7 @@ class SubprocessExecutor(Executor):
             self.extra_env.setdefault(
                 "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1"
             )
+            self.extra_env["JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES"] = "none"
             # the PRODUCER process compiles too (the TPE suggest kernel):
             # share the same cache so a worker restart — or the N-th
             # parallel worker — skips the first-suggest compile stall.
@@ -101,6 +105,9 @@ class SubprocessExecutor(Executor):
                 jax.config.update("jax_compilation_cache_dir", cache)
                 jax.config.update(
                     "jax_persistent_cache_min_compile_time_secs", 1
+                )
+                jax.config.update(
+                    "jax_persistent_cache_enable_xla_caches", "none"
                 )
         # device circuit breaker (failure detection, SURVEY.md §5): a
         # relay/runtime wedge makes EVERY trial burn its full wall-clock
