@@ -95,6 +95,7 @@ def workon(
     # first loop iteration always sweeps (resuming after a crash must
     # free the dead predecessor's reservations before producing)
     last_sweep = 0.0
+    last_broken_note = ""
 
     def heartbeat_for(trial: Trial):
         def beat() -> bool:
@@ -114,7 +115,8 @@ def workon(
         if max_broken is not None and stats.broken >= max_broken:
             log.error(
                 "%s: %d trials broke (max_broken=%d) — is the user script "
-                "runnable? Stopping.", worker_id, stats.broken, max_broken,
+                "runnable? Stopping. Last failure: %s", worker_id,
+                stats.broken, max_broken, last_broken_note or "(no detail)",
             )
             break
 
@@ -222,7 +224,15 @@ def workon(
             )
             stats.broken += res.status == "broken"
             stats.interrupted += res.status == "interrupted"
-            if res.note:
+            if res.status == "broken":
+                # the note carries the evidence (exit code + stderr tail);
+                # at INFO it is invisible under the default CLI level and
+                # the eventual max_broken ERROR reads as evidence-free
+                last_broken_note = res.note
+                if res.note:
+                    log.warning(
+                        "trial %s broken: %s", trial.id[:8], res.note)
+            elif res.note:
                 log.info("trial %s %s: %s", trial.id[:8], res.status, res.note)
         stats.events.append(
             {
