@@ -649,9 +649,13 @@ def main() -> None:
         # TPE metric (or hang the driver). The forensic profile stage
         # gets a tighter budget — it runs last and must never be the
         # stage that pushes the whole bench past an outer deadline
+        # 600 s: a COLD remote compile through the relay runs minutes, and
+        # the xent-gate change makes the default-routing stages fresh
+        # programs on their first post-change run; worst case stays inside
+        # the watcher's 7200 s bench deadline (8×600 + 240 + TPE section)
         rc, out = run_with_deadline(
             [sys.executable, os.path.abspath(__file__), "--stage", name],
-            timeout_s=240.0 if name.startswith("profile-") else 420.0,
+            timeout_s=240.0 if name.startswith("profile-") else 600.0,
             capture=True,
         )
         parsed = None

@@ -69,10 +69,10 @@ MAX_STATE_AGE_H = 24.0
 #: record with eight deadlined stages exits 0 too, and checkpointing it
 #: would strip the MFU/xent/flash story from the round.
 STEPS = (
-    # above bench.py's own worst case (9 stage children: 8×420s + the
-    # profile stage's 240s = 3600s, plus the TPE section and compiles)
+    # above bench.py's own worst case (9 stage children: 8×600s + the
+    # profile stage's 240s = 5040s, plus the TPE section and compiles)
     ("bench", [sys.executable, os.path.join(REPO, "bench.py")],
-     7200.0, ('"backend": "tpu"', '"stage_errors": 0')),
+     9000.0, ('"backend": "tpu"', '"stage_errors": 0')),
     # smoke before flash: the 2026-08-01 window died with flash still
     # compiling and the smoke never started. The smoke proves the round's
     # headline machinery (breaker + requeue budget) live on the chip — an

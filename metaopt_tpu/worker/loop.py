@@ -231,7 +231,8 @@ def workon(
                 last_broken_note = res.note
                 if res.note:
                     log.warning(
-                        "trial %s broken: %s", trial.id[:8], res.note)
+                        "%s: trial %s broken: %s",
+                        worker_id, trial.id[:8], res.note)
             elif res.note:
                 log.info("trial %s %s: %s", trial.id[:8], res.status, res.note)
         stats.events.append(
