@@ -90,11 +90,15 @@ def main() -> None:
     def emit(row) -> None:
         # append to disk the moment a row exists: a relay death mid-sweep
         # (the 2026-08-01 failure mode, "Connection refused" at minute 75)
-        # must not take the already-measured rows with it
+        # must not take the already-measured rows with it. Best-effort —
+        # the row is on stdout, and a disk hiccup must not kill the sweep
         print(json.dumps(row), flush=True)
         if save_path:
-            with open(save_path, "a") as f:
-                f.write(json.dumps({**row, **stamp_now}) + "\n")
+            try:
+                with open(save_path, "a") as f:
+                    f.write(json.dumps({**row, **stamp_now}) + "\n")
+            except OSError as exc:
+                print(json.dumps({"save_error": str(exc)}), flush=True)
 
     h, d = 8, 64
     rows = []

@@ -264,6 +264,17 @@ def main() -> int:
 
     from metaopt_tpu.utils.provenance import provenance
 
+    save_path = None
+    if args.save:
+        stamp = time.strftime("%Y-%m-%d")
+        save_path = os.path.join(
+            REPO, "benchmarks", "results",
+            f"{args.scale}_{backend}_{stamp}.jsonl",
+        )
+    # run id groups one attempt's rows inside the appended-to dated file —
+    # a watcher retry on the same day must not double-count
+    run_id = f"{int(time.time())}-{os.getpid()}"
+
     results = []
     with tempfile.TemporaryDirectory(prefix="mtpu_bench_") as root:
         for name, spec in CONFIGS.items():
@@ -272,9 +283,19 @@ def main() -> int:
             scale = 1.0 if explicit_cap else spec.get("timeout_scale", 1.0)
             res = run_config(name, spec, args.scale, root, backend,
                              cap * scale)
-            res.update(provenance())
+            res.update(provenance(run=run_id))
             print(json.dumps(res), flush=True)
             results.append(res)
+            if save_path:
+                # append the row the moment the config finishes: a relay
+                # death mid-sweep must not take completed rows with it.
+                # Best-effort — the row is already on stdout, and a disk
+                # hiccup must not abort the remaining configs
+                try:
+                    with open(save_path, "a") as f:
+                        f.write(json.dumps(res) + "\n")
+                except OSError as exc:
+                    print(json.dumps({"save_error": str(exc)}), flush=True)
 
     ok = [r for r in results if "error" not in r]
     # the per-row "backend" is the COMMANDED one; prove the chip actually
@@ -294,18 +315,13 @@ def main() -> int:
         "total_trials": sum(r["trials"] for r in ok),
         "total_requeued": sum(r.get("requeued", 0) for r in ok),
         "total_wall_s": round(sum(r["wall_s"] for r in results), 1),
-        **provenance(),
+        **provenance(run=run_id),
     }
     print(json.dumps(summary))
-    if args.save:
-        stamp = time.strftime("%Y-%m-%d")
-        path = os.path.join(
-            REPO, "benchmarks", "results",
-            f"{args.scale}_{backend}_{stamp}.jsonl",
-        )
-        with open(path, "a") as f:
-            for r in results + [summary]:
-                f.write(json.dumps(r) + "\n")
+    if save_path:
+        # rows were appended as configs finished; only the summary is new
+        with open(save_path, "a") as f:
+            f.write(json.dumps(summary) + "\n")
     return 0 if len(ok) == len(results) else 1
 
 
