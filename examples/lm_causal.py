@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Decoder-only causal LM trial — the long-context zoo entry.
+
+    python -m metaopt_tpu hunt -n lm --max-trials 20 \
+        --config examples/tpe.yaml \
+        examples/lm_causal.py \
+        --lr~'loguniform(1e-4, 1e-1)' \
+        --dropout~'uniform(0.0, 0.3)' \
+        --n-layers~'uniform(1, 4, discrete=True)'
+
+``--sp 2`` shards the sequence axis (ring attention over ICI;
+METAOPT_TPU_SP_IMPL=ulysses for the all-to-all variant) — the
+decoder-only model is where long-context sequence parallelism earns
+its keep.
+"""
+
+import argparse
+
+from metaopt_tpu import client
+from metaopt_tpu.client import report_results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, required=True)
+    p.add_argument("--dropout", type=float, default=0.1)
+    p.add_argument("--n-layers", dest="n_layers", type=int, default=2)
+    p.add_argument("--d-model", dest="d_model", type=int, default=128)
+    p.add_argument("--d-ff", dest="d_ff", type=int, default=512)
+    p.add_argument("--seq-len", dest="seq_len", type=int, default=64)
+    p.add_argument("--steps", type=int, default=80)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1)
+    p.add_argument("--n-experts", dest="n_experts", type=int, default=0)
+    a = p.parse_args()
+
+    from metaopt_tpu.models.lm import train_lm
+
+    kw = {}
+    if client.IS_ORCHESTRATED:
+        # orbax trial checkpoints: PBT handoff / suspended-trial resume
+        own, parent = client.checkpoint_paths()
+        kw = {"save_dir": own, "restore_dir": parent or own}
+    loss = train_lm(
+        {"lr": a.lr, "dropout": a.dropout, "d_model": a.d_model,
+         "n_layers": a.n_layers, "d_ff": a.d_ff,
+         "n_heads": max(1, a.d_model // 64), "n_experts": a.n_experts},
+        tp=a.tp, sp=a.sp, ep=a.ep,
+        seq_len=a.seq_len, steps=a.steps,
+        **kw,
+    )
+    report_results([{"name": "loss", "type": "objective", "value": loss}])
+
+
+if __name__ == "__main__":
+    main()

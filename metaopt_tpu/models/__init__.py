@@ -8,6 +8,9 @@ These exist so the five BASELINE configs are runnable end-to-end on TPU:
 - :mod:`resnet`      — ResNet-50/CIFAR-shaped, multi-fidelity (config 3)
 - :mod:`transformer` — Transformer-base, 4-chip sub-slice pjit (config 4)
 - :mod:`ppo`         — PPO actor-critic populations (config 5)
+- :mod:`lm`          — decoder-only causal LM (the long-context flagship
+  shape; reuses the seq2seq blocks, sp ring/Ulysses attention, and the
+  measured blocked-xent routing)
 
 All use synthetic data generated on device (zero-egress environment — no
 dataset downloads), bfloat16 matmuls for the MXU, donated buffers, and

@@ -55,6 +55,30 @@ def synthetic_seq2seq(
     return src, tgt
 
 
+def synthetic_lm(
+    key: jax.Array,
+    n: int,
+    seq_len: int = 64,
+    vocab: int = 1000,
+    teacher_seed: int = 7,
+) -> jnp.ndarray:
+    """Permutation-walk token streams: ``x[t+1] = perm[x[t]]`` from a random
+    start — next-token prediction is exactly learnable (a one-step Markov
+    map over [2, vocab), so 0=pad / 1=bos never appear mid-stream). The
+    permutation is keyed by ``teacher_seed`` so train/val draws share one
+    "language"."""
+    perm = 2 + jax.random.permutation(
+        jax.random.PRNGKey(teacher_seed), vocab - 2)
+    start = jax.random.randint(key, (n,), 2, vocab)
+
+    def body(tok, _):
+        nxt = perm[tok - 2]
+        return nxt, nxt
+
+    _, cols = jax.lax.scan(body, start, None, length=seq_len - 1)
+    return jnp.concatenate([start[:, None], cols.T], axis=1)
+
+
 def batches(
     x: jnp.ndarray, y: jnp.ndarray, batch_size: int, key: jax.Array
 ) -> Iterator[Tuple[jnp.ndarray, jnp.ndarray]]:

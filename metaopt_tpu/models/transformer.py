@@ -350,6 +350,38 @@ def blocked_xent_enabled(batch: int, seq: int, vocab: int) -> bool:
     return per_device >= _BLOCKED_XENT_MIN_LOGITS_BYTES
 
 
+def readout_xent(out, params, labels, vocab, blocked):
+    """Per-token xent from the model output against the tied embedding.
+
+    ``out`` is pre-readout features when ``blocked`` (the f32 (B, T, V)
+    logits tensor never exists in HBM — ops/xent.py folds the tied readout
+    into a blocked online-softmax), else full logits. Shared by the
+    seq2seq loss below and the decoder-only LM (models/lm.py), so the
+    routing measured on the bench applies to both families.
+    """
+    if blocked:
+        from metaopt_tpu.ops.xent import blocked_softmax_xent, pick_block_v
+
+        emb = params["embed"]["embedding"]
+        if hasattr(emb, "unbox"):  # nn.Partitioned leaf (sharded init path)
+            emb = emb.unbox()
+        feats = out.reshape(-1, out.shape[-1]).astype(jnp.bfloat16)
+        return blocked_softmax_xent(
+            feats, emb.astype(jnp.bfloat16), labels.reshape(-1),
+            pick_block_v(vocab),
+        ).reshape(labels.shape)
+    return optax.softmax_cross_entropy_with_integer_labels(out, labels)
+
+
+def masked_mean_with_aux(loss, mask, mutated, moe_aux_weight):
+    """Masked token-mean plus the MoE switch load-balancing term."""
+    total = (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    aux = jax.tree.leaves(mutated.get("aux_loss", {}))
+    if aux:
+        total = total + moe_aux_weight * sum(jnp.sum(a) for a in aux)
+    return total
+
+
 def loss_fn(model, params, batch, dropout_key, moe_aux_weight: float = 0.01):
     src, tgt = batch
     bos = jnp.ones((tgt.shape[0], 1), tgt.dtype)
@@ -361,27 +393,8 @@ def loss_fn(model, params, batch, dropout_key, moe_aux_weight: float = 0.01):
         mutable=["aux_loss"],
     )
     mask = (tgt != 0).astype(jnp.float32)
-    if blocked:
-        # HBM-threatening logits: fold the tied readout into a blocked
-        # online-softmax xent (ops/xent.py) — the f32 (B, T, V) tensor
-        # never exists in HBM
-        from metaopt_tpu.ops.xent import blocked_softmax_xent, pick_block_v
-
-        emb = params["embed"]["embedding"]
-        if hasattr(emb, "unbox"):  # nn.Partitioned leaf (sharded init path)
-            emb = emb.unbox()
-        feats = out.reshape(-1, out.shape[-1]).astype(jnp.bfloat16)
-        loss = blocked_softmax_xent(
-            feats, emb.astype(jnp.bfloat16), tgt.reshape(-1),
-            pick_block_v(model.vocab),
-        ).reshape(tgt.shape)
-    else:
-        loss = optax.softmax_cross_entropy_with_integer_labels(out, tgt)
-    total = (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    aux = jax.tree.leaves(mutated.get("aux_loss", {}))
-    if aux:  # switch load-balancing term from MoE layers
-        total = total + moe_aux_weight * sum(jnp.sum(a) for a in aux)
-    return total
+    loss = readout_xent(out, params, tgt, model.vocab, blocked)
+    return masked_mean_with_aux(loss, mask, mutated, moe_aux_weight)
 
 
 def make_train_step(model, tx):
@@ -414,32 +427,87 @@ def init_sharded(
         params = model.init(key, src, src, train=False)["params"]
         return params, tx.init(params)
 
-    def prune(spec):
-        """Drop partition-axis names the mesh doesn't have (→ replicated).
+    return sharded_init(init_fn, mesh, seed)
 
-        Model code annotates the FULL parallel surface (tp/ep/...); a
-        trial mesh that only carves out some axes still initializes — the
-        un-carved axes just stay unsharded.
-        """
-        if not isinstance(spec, P):
-            return spec
-        cleaned = []
-        for entry in spec:
-            if entry is None:
-                cleaned.append(None)
-            elif isinstance(entry, (tuple, list)):
-                kept = tuple(a for a in entry if a in mesh.axis_names)
-                cleaned.append(kept if kept else None)
-            else:
-                cleaned.append(entry if entry in mesh.axis_names else None)
-        return P(*cleaned)
 
+def _prune_spec(spec, mesh):
+    """Drop partition-axis names the mesh doesn't have (→ replicated).
+
+    Model code annotates the FULL parallel surface (tp/ep/...); a
+    trial mesh that only carves out some axes still initializes — the
+    un-carved axes just stay unsharded.
+    """
+    if not isinstance(spec, P):
+        return spec
+    cleaned = []
+    for entry in spec:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(entry if entry in mesh.axis_names else None)
+    return P(*cleaned)
+
+
+def sharded_init(init_fn, mesh: Mesh, seed: int = 0):
+    """Run ``init_fn(key)`` with outputs materialized directly sharded.
+
+    Shared by the seq2seq ``init_sharded`` above and the decoder-only LM
+    (models/lm.py): partition annotations flow through jax.eval_shape →
+    NamedSharding, so big kernels never exist host-resident/replicated.
+    """
     key = jax.random.PRNGKey(seed)
     shapes = jax.eval_shape(init_fn, key)
     specs = nn.get_partition_spec(shapes)
-    shardings = jax.tree.map(lambda sp: NamedSharding(mesh, prune(sp)), specs)
-    params, opt_state = jax.jit(init_fn, out_shardings=shardings)(key)
-    return params, opt_state, shardings
+    shardings = jax.tree.map(
+        lambda sp: NamedSharding(mesh, _prune_spec(sp, mesh)), specs)
+    out = jax.jit(init_fn, out_shardings=shardings)(key)
+    return (*out, shardings)
+
+
+def trial_setup(hparams: Dict[str, Any], mesh: Optional[Mesh],
+                tp: int, sp: int, ep: int, steps: int):
+    """The shared trial-harness preamble: mesh assembly + optimizer.
+
+    sp > 1 shards the sequence axis (ring attention over ICI); ep > 1
+    carves an expert axis for MoE FFNs (n_experts hparam). Used by both
+    zoo training harnesses (seq2seq below, decoder-only LM in lm.py) so
+    mesh/scheduler behavior cannot drift between families.
+    """
+    from metaopt_tpu.parallel.mesh import trial_mesh
+
+    extra = []
+    if sp > 1:
+        extra.append(("sp", sp))
+    if ep > 1:
+        extra.append(("ep", ep))
+    mesh = mesh or trial_mesh(tp=tp, extra_axes=tuple(extra))
+    lr = float(hparams.get("lr", 1e-3))
+    warmup = int(hparams.get("warmup", 10))
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup, max(steps, warmup + 1))
+    tx = optax.adamw(sched,
+                     weight_decay=float(hparams.get("weight_decay", 0.0)))
+    return mesh, tx
+
+
+def maybe_restore(restore_dir: Optional[str], params, opt_state, shardings):
+    """Orbax trial-checkpoint restore (no-op when dir is empty/absent).
+
+    How a PBT continuation inherits its parent's training state and a
+    suspended trial resumes (models/checkpoint.py).
+    """
+    if restore_dir:
+        from metaopt_tpu.models.checkpoint import has_state, restore_state
+
+        if has_state(restore_dir):
+            params = restore_state(restore_dir + "/params", params,
+                                   shardings[0])
+            opt_state = restore_state(restore_dir + "/opt_state",
+                                      opt_state, shardings[1])
+    return params, opt_state
 
 
 def train_and_eval(
@@ -463,21 +531,13 @@ def train_and_eval(
     optimizer state) — how a PBT continuation inherits its parent's
     training state and a suspended trial resumes (models/checkpoint.py).
     """
-    from metaopt_tpu.parallel.mesh import trial_mesh, use_mesh
+    from metaopt_tpu.parallel.mesh import use_mesh
 
-    # sp > 1 shards the sequence axis (ring attention over ICI); ep > 1
-    # carves an expert axis for MoE FFNs (n_experts hparam)
-    extra = []
-    if sp > 1:
-        extra.append(("sp", sp))
-    if ep > 1:
-        extra.append(("ep", ep))
-    mesh = mesh or trial_mesh(tp=tp, extra_axes=tuple(extra))
+    if n_train < batch_size:
+        raise ValueError(
+            f"n_train ({n_train}) must be >= batch_size ({batch_size})")
+    mesh, tx = trial_setup(hparams, mesh, tp, sp, ep, steps)
     model = make_model(hparams)
-    lr = float(hparams.get("lr", 1e-3))
-    warmup = int(hparams.get("warmup", 10))
-    sched = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, max(steps, warmup + 1))
-    tx = optax.adamw(sched, weight_decay=float(hparams.get("weight_decay", 0.0)))
 
     key = jax.random.PRNGKey(seed)
     kd, kstep = jax.random.split(key)
@@ -487,14 +547,8 @@ def train_and_eval(
         params, opt_state, shardings = init_sharded(
             model, mesh, tx, (batch_size, seq_len), seed
         )
-        if restore_dir:
-            from metaopt_tpu.models.checkpoint import has_state, restore_state
-
-            if has_state(restore_dir):
-                params = restore_state(restore_dir + "/params", params,
-                                       shardings[0])
-                opt_state = restore_state(restore_dir + "/opt_state",
-                                          opt_state, shardings[1])
+        params, opt_state = maybe_restore(
+            restore_dir, params, opt_state, shardings)
         step_fn = jax.jit(
             make_train_step(model, tx),
             in_shardings=(
@@ -506,8 +560,8 @@ def train_and_eval(
         )
         loss = None
         for i in range(steps):
-            sl = slice((i * batch_size) % (n_train - batch_size),
-                       (i * batch_size) % (n_train - batch_size) + batch_size)
+            lo = (i * batch_size) % (n_train - batch_size + 1)
+            sl = slice(lo, lo + batch_size)
             batch = shard_batch(mesh, (src[sl], tgt[sl]))
             params, opt_state, loss = step_fn(
                 params, opt_state, batch, jax.random.fold_in(kstep, i)
