@@ -774,6 +774,12 @@ def main() -> None:
     if value_tpu_last_good is not None:
         compact["value_tpu_last_good"] = value_tpu_last_good
     for key in ("mfu_seq256", "mfu_seq512", "mfu_seq1024", "resnet50_mfu",
+                # pre-gate-change records (xent routing measured 2026-08-01)
+                # carry the product-routing MFU under the _matxent A/B tag;
+                # surface it so a carried last_good still shows the number
+                # the current code would produce
+                "mfu_seq256_matxent", "mfu_seq512_matxent",
+                "mfu_seq1024_matxent",
                 "xent_blocked_step_speedup_seq256",
                 "xent_blocked_step_speedup_seq512",
                 "xent_blocked_step_speedup_seq1024",
