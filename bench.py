@@ -589,6 +589,29 @@ def main() -> None:
         [_observe_gap_suggest(i) for i in range(r(10))]
     ))
 
+    # transfer/launch telemetry: steady-state device traffic of one
+    # observe→suggest cycle. Before the incremental buffers every fit
+    # re-uploaded the whole padded (N, d) matrix — O(N·d) ≈ 440 KB per
+    # suggest at 10k obs on this space; the device-resident buffer appends
+    # one donated row per observe, O(d) bytes
+    tel0 = tpe.telemetry()
+    tel_cycles = r(10)
+    for i in range(tel_cycles):
+        pt = tpe.space.sample(1, seed=200_000 + i)[0]
+        tpe.observe([_completed(pt, float(1000 + i))])
+        tpe.suggest(pool)
+    t = tpe._refill_thread
+    if t is not None:
+        t.join(timeout=60)  # settle in-flight speculative launches
+    tel1 = tpe.telemetry()
+    h2d_per_suggest = (tel1["h2d_bytes"] - tel0["h2d_bytes"]) / tel_cycles
+    launches_per_suggest = (
+        tel1["kernel_launches"] - tel0["kernel_launches"]) / tel_cycles
+    from metaopt_tpu.ops.tpe_math import pad_pow2 as _pad_pow2
+
+    d_dims = tpe.cube.n_dims
+    rebuild_bytes = _pad_pow2(len(tpe._y) + 1) * (d_dims + 1) * 4
+
     # the reference substrate refits + rescores per suggestion (host numpy)
     numpy_ms = time_fn(lambda: numpy_ei_reference(tpe), repeats=r(5))
 
@@ -714,6 +737,9 @@ def main() -> None:
             "single_suggest_ms": round(single_ms, 3),
             "single_suggest_uncached_ms": round(single_uncached_ms, 3),
             "suggest_after_observe_100ms_gap_ms": round(after_observe_ms, 3),
+            "h2d_bytes_per_suggest": round(h2d_per_suggest, 1),
+            "kernel_launches_per_suggest": round(launches_per_suggest, 2),
+            "h2d_bytes_full_rebuild_equiv": rebuild_bytes,
             "jax_1k_obs_ms_per_point": round(jax_1k_ms, 3),
             "flatness_10k_over_1k": round(jax_ms / max(jax_1k_ms, 1e-9), 2),
             **flat_16k,
@@ -784,6 +810,7 @@ def main() -> None:
                 "xent_blocked_step_speedup_seq512",
                 "xent_blocked_step_speedup_seq1024",
                 "flatness_16k_over_1k", "flatness_32k_over_1k",
+                "h2d_bytes_per_suggest", "kernel_launches_per_suggest",
                 "transformer_tokens_per_s_seq512", "resnet50_images_per_s",
                 "flash_vs_chunked_crossover"):
         if key in src:

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Regression gate for the headline suggest-latency metric.
+
+Compares a bench artifact (newest ``benchmarks/results/bench_*.json`` by
+default) against the most recent committed round record (``BENCH_r*.json``)
+on ``tpe_suggest_ms_per_point_10k_obs_pool8`` and exits non-zero when the
+headline regressed by more than ``--threshold`` (default 10%).
+
+Doctrine:
+
+- **Like-for-like substrate**: a CPU artifact is judged ONLY against CPU
+  round baselines and a TPU artifact only against TPU ones. The relay wedge
+  that degrades bench to CPU multiplies the headline ~7× — comparing across
+  substrates would turn every wedge into a phantom regression (and every
+  recovery into a phantom win).
+- **``stale: true`` warns, never fails by itself**: a CPU-fallback run is
+  flagged stale because it did not refresh the TPU story; that staleness is
+  reported as a warning, while the CPU-vs-CPU regression gate still applies
+  to the numbers actually measured.
+- No matching-substrate baseline → informational pass (nothing to gate
+  against; first round on a new substrate must not fail).
+
+Usage::
+
+    python benchmarks/check_regression.py [--artifact PATH] [--threshold 0.10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+METRIC = "tpe_suggest_ms_per_point_10k_obs_pool8"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def newest_artifact() -> str:
+    paths = glob.glob(os.path.join(REPO, "benchmarks", "results",
+                                   "bench_*.json"))
+    if not paths:
+        raise SystemExit("no bench artifact under benchmarks/results/ — "
+                         "run `python bench.py` first")
+    return max(paths, key=os.path.getmtime)
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        rec = json.load(f)
+    if rec.get("metric") != METRIC or "value" not in rec:
+        raise SystemExit(f"{path}: not a {METRIC} bench record")
+    backend = (rec.get("extra") or {}).get("backend") or rec.get("backend")
+    return {"value": float(rec["value"]), "backend": backend or "unknown",
+            "path": path}
+
+
+def round_baselines() -> list:
+    """(round_name, backend, value) for every committed BENCH_r*.json,
+    oldest→newest (names embed the round number, so lexical order works)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed") or {}
+        if parsed.get("metric") == METRIC and "value" in parsed:
+            out.append((os.path.basename(path),
+                        parsed.get("backend", "unknown"),
+                        float(parsed["value"])))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--artifact", default=None,
+                    help="bench artifact to check (default: newest under "
+                         "benchmarks/results/)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="allowed fractional regression (default 0.10)")
+    args = ap.parse_args()
+
+    art = load_artifact(args.artifact or newest_artifact())
+    if art["backend"] != "tpu":
+        print(f"WARNING: artifact is a {art['backend']} run (stale: true) — "
+              "the TPU headline was not refreshed; gating CPU-vs-CPU only")
+
+    matching = [b for b in round_baselines() if b[1] == art["backend"]]
+    if not matching:
+        print(f"no committed {art['backend']} baseline in BENCH_r*.json — "
+              "nothing to gate against (pass)")
+        return 0
+    base_name, _, base_value = matching[-1]
+    ratio = art["value"] / base_value
+    verdict = (f"{METRIC}: {art['value']:.3f} ms vs {base_value:.3f} ms "
+               f"({base_name}, {art['backend']}) → {ratio:.3f}x")
+    if ratio > 1.0 + args.threshold:
+        print(f"FAIL {verdict} — regressed past the "
+              f"{args.threshold:.0%} threshold")
+        return 1
+    print(f"OK {verdict}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

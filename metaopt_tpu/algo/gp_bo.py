@@ -39,6 +39,7 @@ import numpy as np
 import optax
 
 from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry
+from metaopt_tpu.algo.obs_buffer import ObservationBuffer
 from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.ops.tpe_math import pad_pow2
 from metaopt_tpu.space import Space, UnitCube
@@ -85,10 +86,11 @@ def _neg_mll(params, X, y, mask):
     jax.jit, static_argnames=("fit_iters", "n_cand", "n_out")
 )
 def gp_suggest_fused(
-    X,            # (N, d) unit-cube observations, padded
-    y,            # (N,) objectives, 0 padding (standardized)
-    mask,         # (N,) 1.0 for live rows
-    best_y,       # scalar: incumbent (standardized)
+    X,            # (N, d) unit-cube observations, pow2-padded device buffer
+    y_raw,        # (N,) RAW objectives (inf padding; may hold NaN/inf rows)
+    n,            # scalar: live row count (rows [0, n) are observations)
+    mu,           # scalar: standardization mean over FINITE objectives
+    sd,           # scalar: standardization std over FINITE objectives
     key,          # PRNG key for candidate draws
     fit_lr,
     *,
@@ -96,8 +98,21 @@ def gp_suggest_fused(
     n_cand: int,
     n_out: int,
 ):
-    """Fit hyperparameters (Adam on exact MLL) + EI top-k in ONE program."""
+    """Fit hyperparameters (Adam on exact MLL) + EI top-k in ONE program.
+
+    The live mask and the standardized targets are derived IN-kernel from
+    the raw device buffer (``idx < n`` and finiteness — a diverged trial's
+    NaN/inf objective would poison the fit through the mean/std, so such
+    rows drop out of the mask entirely; TPE-by-argsort sends them to the
+    bad set, a GP has no analogous refuge). The host only ships the O(1)
+    scalars (n, mu, sd): the observation matrix itself stays resident.
+    """
     d = X.shape[1]
+    idx = jnp.arange(X.shape[0])
+    live = (idx < n) & jnp.isfinite(y_raw)
+    mask = live.astype(jnp.float32)
+    y = jnp.where(live, (y_raw - mu) / sd, 0.0)
+    best_y = jnp.min(jnp.where(live, y, jnp.inf))
     params = {
         "log_ls": jnp.zeros(d) + jnp.log(0.3),
         "log_amp": jnp.asarray(0.0),
@@ -322,6 +337,11 @@ class GPBO(BaseAlgorithm):
         self.cube = UnitCube(space)
         self._X: List[np.ndarray] = []
         self._y: List[float] = []
+        # device-resident mirror of (_X, _y): appends stream one O(d) row
+        # at a time instead of re-uploading the whole padded matrix per
+        # fit (same buffer contract as TPE — see algo/obs_buffer.py)
+        self._buf = ObservationBuffer(self.cube.n_dims)
+        self._launches = 0
         self._pending_X: List[np.ndarray] = []   # lie rows, ephemeral
         self._pending_fp: tuple = ()
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
@@ -373,36 +393,28 @@ class GPBO(BaseAlgorithm):
             self._prefetch = self._prefetch[num:]
             return out
         n_total = len(self._y)
-        # a diverged trial's NaN/inf objective would poison the WHOLE fit
-        # through the mean/std standardization — exclude it from the GP
-        # entirely (TPE-by-argsort sends such rows to the bad set; a GP
-        # has no analogous refuge)
-        finite = [(x, v) for x, v in zip(self._X, self._y)
-                  if np.isfinite(v)]
-        X_rows = [x for x, _ in finite]
-        y_list = [v for _, v in finite]
-        if not y_list:  # every observation diverged: explore uniformly
+        y_fin = [v for v in self._y if np.isfinite(v)]
+        if not y_fin:  # every observation diverged: explore uniformly
             return [self.space.sample(1, seed=self.rng)[0]
                     for _ in range(num)]
+        # incremental device sync: only rows the device has not seen cross
+        # the PCIe boundary (non-finite rows ride along — the kernel's
+        # finiteness mask drops them from the fit)
+        self._buf.sync(self._X, self._y)
+        stats = list(y_fin)
         if self._pending_X and self.parallel_strategy is not None:
             # the constant lie, from the finite observations only
-            lie = (float(np.mean(y_list))
+            lie = (float(np.mean(y_fin))
                    if self.parallel_strategy == "mean"
-                   else float(np.max(y_list)))
-            X_rows = X_rows + self._pending_X
-            y_list = y_list + [lie] * len(self._pending_X)
-        n_eff = len(y_list)
-        d = self.cube.n_dims
-        npad = pad_pow2(n_eff)
-        X = np.zeros((npad, d), np.float32)
-        X[:n_eff] = np.stack(X_rows)
-        y_raw = np.asarray(y_list, np.float32)
-        # standardize: MLL fit assumes O(1) targets
-        mu, sd = float(y_raw.mean()), float(y_raw.std() + 1e-8)
-        y = np.zeros(npad, np.float32)
-        y[:n_eff] = (y_raw - mu) / sd
-        fit_mask = np.zeros(npad, np.float32)
-        fit_mask[:n_eff] = 1.0
+                   else float(np.max(y_fin)))
+            Xd, yd, n_eff = self._buf.overlay(self._pending_X, lie)
+            stats += [lie] * len(self._pending_X)
+        else:
+            Xd, yd, n_eff = self._buf.Xdev, self._buf.ydev, self._buf.n
+        # standardize: MLL fit assumes O(1) targets. Stats on the host
+        # (over finite obs + lies) — only these scalars are shipped
+        stats_arr = np.asarray(stats, np.float32)
+        mu, sd = float(stats_arr.mean()), float(stats_arr.std() + 1e-8)
         if self._pool_n != n_total:
             self._pool_n, self._pool_idx = n_total, 0
         key = jax.random.fold_in(
@@ -412,9 +424,9 @@ class GPBO(BaseAlgorithm):
         )
         self._pool_idx += 1
         n_out = pad_pow2(max(num, self.pool_prefetch), minimum=1)
+        self._launches += 1
         best = np.asarray(gp_suggest_fused(
-            jnp.asarray(X), jnp.asarray(y), jnp.asarray(fit_mask),
-            float(y[:n_eff].min()), key, self.fit_lr,
+            Xd, yd, n_eff, mu, sd, key, self.fit_lr,
             fit_iters=self.fit_iters,
             n_cand=pad_pow2(self.n_candidates),
             n_out=n_out,
@@ -429,6 +441,16 @@ class GPBO(BaseAlgorithm):
         out, self._prefetch = pts[:num], pts[num:]
         self._prefetch_n_obs = n_total
         return out
+
+    def telemetry(self) -> Dict[str, int]:
+        """Transfer/launch counters for the bench (same keys as TPE)."""
+        return {
+            "h2d_bytes": self._buf.h2d_bytes,
+            "appends": self._buf.appends,
+            "bulk_uploads": self._buf.bulk_uploads,
+            "reallocs": self._buf.reallocs,
+            "kernel_launches": self._launches,
+        }
 
     def seed_rng(self, seed: Optional[int]) -> None:
         super().seed_rng(seed)
@@ -455,6 +477,9 @@ class GPBO(BaseAlgorithm):
         super().load_state_dict(state)
         self._X = [np.asarray(x, np.float32) for x in state.get("X", [])]
         self._y = list(state.get("y", []))
+        # restored host lists may differ row-for-row from what the device
+        # holds: drop the mirror, the next fit re-syncs from scratch
+        self._buf.reset()
         self._prefetch = [dict(p) for p in state.get("prefetch", [])]
         self._prefetch_n_obs = int(state.get("prefetch_n_obs", -1))
         self._pool_n = int(state.get("pool_n", -1))

@@ -32,11 +32,13 @@ import numpy as np
 import jax
 
 from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry
+from metaopt_tpu.algo.obs_buffer import ObservationBuffer
 from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.ops.tpe_math import (
     adaptive_bandwidths,
     ei_scores,
     pad_pow2,
+    split_pads,
     tpe_suggest_fused,
 )
 from metaopt_tpu.space import Space, UnitCube
@@ -116,15 +118,12 @@ class TPE(BaseAlgorithm):
         #: max categories across dims (table width for the kernel)
         self._kmax = int(max(1, self.cube.n_choices.max()))
 
-        # device-resident observation buffers for the fused suggest kernel
-        # (padded to pow2 ≥ n+1 so the prior pseudo-component always fits)
-        self._cap = 0
-        self._Xbuf: Optional[np.ndarray] = None   # host mirror, (cap, d)
-        self._ybuf: Optional[np.ndarray] = None   # host mirror, (cap,)
-        self._n_synced = 0                        # rows already in host mirror
-        self._Xdev = None
-        self._ydev = None
-        self._n_dev = -1                          # count the device copy holds
+        # device-resident observation buffer for the fused suggest kernel
+        # (padded to pow2 ≥ n+1 so the prior pseudo-component always fits).
+        # observe() costs O(d) host→device per new row — the buffer grows
+        # in place with donated appends instead of host rebuild+re-upload
+        self._buf = ObservationBuffer(self.cube.n_dims)
+        self._launches = 0                        # fused-kernel launch count
         self._n_choices_dev = None
         self._cont_mask_dev = None
         # kernel PRNG seed: deterministic for a given ctor seed, OS-entropy
@@ -149,9 +148,14 @@ class TPE(BaseAlgorithm):
         self._prefetch_n_obs = -1
         # latency machinery (tunneled PJRT backends pay ~70 ms per blocking
         # launch+readback; compiles cost seconds):
-        # - one RLock serializes every reader/writer of the observation
-        #   buffers, the PRNG stream, and the prefetch pool — interleavings
-        #   of the refill thread and the caller can't diverge the stream
+        # - _kernel_lock guards the HOST state: observation lists, PRNG
+        #   stream position, prefetch pool, pending set. Held only for
+        #   snapshots and commits — never across a kernel launch, so
+        #   observe()/score()/set_pending() proceed while XLA runs
+        # - _launch_lock serializes launch+readback sequences (refill
+        #   thread vs caller) so pools commit in stream order. Lock order
+        #   is ALWAYS launch → kernel; never acquire _launch_lock while
+        #   holding _kernel_lock
         # - _warmup fires on the first random-phase suggest: the EI kernel
         #   for the first post-initial-points shape compiles in the
         #   background while the initial random trials run
@@ -159,6 +163,7 @@ class TPE(BaseAlgorithm):
         #   the next suggest() finds its points already computed (or at
         #   least the launch already in flight)
         self._kernel_lock = threading.RLock()
+        self._launch_lock = threading.RLock()
         self._warmup_started = False
         self._warmup_thread: Optional[threading.Thread] = None
         self._refill_thread: Optional[threading.Thread] = None
@@ -214,7 +219,10 @@ class TPE(BaseAlgorithm):
                 self._maybe_warmup_async()
                 return [self.space.sample(1, seed=self.rng)[0]
                         for _ in range(num)]
-            return self._suggest_ei(num)
+        # EI path runs with the kernel lock RELEASED — _suggest_ei takes
+        # launch → kernel in that order (observations only grow, so the
+        # threshold check above cannot be invalidated by the gap)
+        return self._suggest_ei(num)
 
     # -- background compile / speculative refill ---------------------------
     def _maybe_warmup_async(self) -> None:
@@ -236,6 +244,8 @@ class TPE(BaseAlgorithm):
         n_choices = self.cube.n_choices.astype(np.int32)
         cont = ~self.cube.categorical_mask
 
+        g_pad, b_pad = split_pads(self.n_initial_points, self.gamma)
+
         def work() -> None:
             try:
                 tpe_suggest_fused(
@@ -248,6 +258,7 @@ class TPE(BaseAlgorithm):
                     self.gamma, self.prior_weight, self.full_weight_num,
                     n_cand=self.n_ei_candidates, n_out=n_out,
                     kmax=self._kmax, equal_weight=self.equal_weight,
+                    n_good_pad=g_pad, n_bad_pad=b_pad,
                 ).block_until_ready()
             except Exception as exc:  # warmup is best-effort
                 logging.getLogger(__name__).debug("tpe warmup failed: %s", exc)
@@ -263,10 +274,12 @@ class TPE(BaseAlgorithm):
         Fires after ``observe()`` once EI suggesting is active: the worker
         spends its inter-trial time on ledger RPCs and subprocess teardown,
         which is exactly the window the kernel launch + readback (~70 ms on
-        a tunneled backend) can hide in. The refill holds the kernel lock,
+        a tunneled backend) can hide in. The refill holds the LAUNCH lock,
         so a concurrent ``suggest()`` simply waits for the fresh pool
         instead of racing it; either interleaving serves the same points
-        from the same PRNG stream position.
+        from the same PRNG stream position. The kernel lock is only taken
+        for the snapshot and the commit — observe()/set_pending() run
+        freely while the kernel itself executes.
         """
         if not self._ei_active or len(self._y) < self.n_initial_points:
             return
@@ -275,9 +288,11 @@ class TPE(BaseAlgorithm):
 
         def work() -> None:
             try:
-                with self._kernel_lock:
-                    if (self._prefetch_n_obs != len(self._y)
-                            or not self._prefetch):
+                with self._launch_lock:
+                    with self._kernel_lock:
+                        needed = (self._prefetch_n_obs != len(self._y)
+                                  or not self._prefetch)
+                    if needed:
                         self._refill_pool()
             except Exception as exc:  # next suggest() will retry inline
                 logging.getLogger(__name__).debug("tpe refill failed: %s", exc)
@@ -287,18 +302,31 @@ class TPE(BaseAlgorithm):
         )
         self._refill_thread.start()
 
-    def _refill_pool(self) -> None:
-        """One uniform pool-width launch appended to the prefetch (locked).
+    def _refill_pool(self, min_points: Optional[int] = None) -> None:
+        """One launch appended to the prefetch (caller holds _launch_lock).
 
-        Launches are ALWAYS ``pool_prefetch`` wide: a single compiled n_out
+        Pools are ALWAYS ``pool_prefetch`` wide: a single compiled n_out
         variant serves every call pattern, and any interleaving of refill
         thread and caller produces the identical suggestion stream (same
-        widths, same ``count`` order).
+        widths, same ``count`` order). A request larger than one pool
+        batches several pools into the SAME launch (see ``_launch_ei``).
+
+        The launch runs without the kernel lock; the result is committed
+        only if the fit (observation count, pending set) is unchanged —
+        a stale pool is discarded, burning pool indices that a replay
+        never makes, which is safe because the stream is keyed by
+        (n_obs, pool_idx), not by a global launch counter.
         """
-        if self._prefetch_n_obs != len(self._y):
-            self._prefetch = []
-            self._prefetch_n_obs = len(self._y)
-        self._prefetch.extend(self._launch_ei(self.pool_prefetch))
+        with self._kernel_lock:
+            fit_id = (len(self._y), self._pending_fp)
+        pts = self._launch_ei(max(self.pool_prefetch, int(min_points or 0)))
+        with self._kernel_lock:
+            if (len(self._y), self._pending_fp) != fit_id:
+                return  # computed against an outdated fit: discard
+            if self._prefetch_n_obs != len(self._y):
+                self._prefetch = []
+                self._prefetch_n_obs = len(self._y)
+            self._prefetch.extend(pts)
 
     def _split(self) -> Tuple[np.ndarray, np.ndarray]:
         """Indices of good (below) / bad (above) observations."""
@@ -391,33 +419,18 @@ class TPE(BaseAlgorithm):
             out[:, j] = np.clip(draws, 1e-6, 1 - 1e-6)
         return out
 
-    def _sync_device(self) -> None:
-        """Mirror host observations into the padded device buffers.
-
-        Appends only the new rows to the host mirror; uploads once per
-        change. Reallocation (pow2 growth) happens O(log n) times total.
-        """
-        n = len(self._y)
-        d = self.cube.n_dims
-        need = pad_pow2(n + 1)
-        if need != self._cap:
-            self._cap = need
-            self._Xbuf = np.full((need, d), 0.5, np.float32)
-            self._ybuf = np.full(need, np.inf, np.float32)
-            self._n_synced = 0
-        if self._n_synced < n:
-            for i in range(self._n_synced, n):
-                self._Xbuf[i] = self._X[i]
-                self._ybuf[i] = self._y[i]
-            self._n_synced = n
-        if self._n_dev != n:
-            self._Xdev = jnp.asarray(self._Xbuf)
-            self._ydev = jnp.asarray(self._ybuf)
-            self._n_dev = n
-        if self._n_choices_dev is None:
-            self._n_choices_dev = jnp.asarray(
-                self.cube.n_choices.astype(np.int32))
-            self._cont_mask_dev = jnp.asarray(~self.cube.categorical_mask)
+    def telemetry(self) -> Dict[str, int]:
+        """Device-traffic counters (cumulative): H2D payload bytes moved by
+        the observation buffer and fused-kernel launches. The bench divides
+        deltas of these by suggests served."""
+        b = self._buf
+        return {
+            "h2d_bytes": b.h2d_bytes,
+            "appends": b.appends,
+            "bulk_uploads": b.bulk_uploads,
+            "reallocs": b.reallocs,
+            "kernel_launches": self._launches,
+        }
 
     def _suggest_one_ei(self) -> Dict[str, Any]:
         return self._suggest_ei(1)[0]
@@ -433,66 +446,86 @@ class TPE(BaseAlgorithm):
         (or is in flight — it holds the kernel lock), this serves without
         touching the device at all.
         """
-        with self._kernel_lock:
-            self._ei_active = True
-            if self._prefetch_n_obs != len(self._y):
-                self._prefetch = []
-                self._prefetch_n_obs = len(self._y)
-            while len(self._prefetch) < num:
-                self._refill_pool()
-            out = self._prefetch[:num]
-            self._prefetch = self._prefetch[num:]
-            return out
+        with self._launch_lock:
+            while True:
+                with self._kernel_lock:
+                    self._ei_active = True
+                    if self._prefetch_n_obs != len(self._y):
+                        self._prefetch = []
+                        self._prefetch_n_obs = len(self._y)
+                    if len(self._prefetch) >= num:
+                        out = self._prefetch[:num]
+                        self._prefetch = self._prefetch[num:]
+                        return out
+                    missing = num - len(self._prefetch)
+                self._refill_pool(missing)
 
     def _launch_ei(self, num: int) -> List[Dict[str, Any]]:
-        """One kernel launch + one readback for the whole pool of ``num``."""
-        self._sync_device()
-        if self._base_key is None:
-            self._base_key = jax.random.PRNGKey(self._kernel_seed)
-        n = len(self._y)
-        if self._pool_n != n:
-            self._pool_n, self._pool_idx = n, 0
-        count = self._pool_idx
-        self._pool_idx += 1
-        # key = fold_in(fold_in(base, n_obs), pool_idx): the stream at one
-        # fit never depends on how many (possibly discarded) launches other
-        # fits made — see _pool_n in __init__
-        fit_key = jax.random.fold_in(self._base_key, n)
-        # pad the pool axis to a power of two: the producer's pool size
-        # shrinks near max_trials, and n_out is a static (compile-time) shape
-        n_out = pad_pow2(num, minimum=1)
-        X_dev, y_dev, n_eff = self._Xdev, self._ydev, n
-        if self._pending_X and self.parallel_strategy is not None and n > 0:
-            # lie rows ride as extra observations; values derive from the
-            # live fit (mean = neutral, max = pessimistic), so a completed
-            # trial's truth replaces its lie on the next cycle. NaN
-            # objectives (diverged trials, legal input — argsort sends
-            # them to the bad set) must not poison the lie
-            lie = (float(np.nanmean(self._y))
-                   if self.parallel_strategy == "mean"
-                   else float(np.nanmax(self._y)))
-            if np.isfinite(lie):
-                aug_key = (n, self._pending_fp)
-                if self._aug_key != aug_key:
-                    # build once per (fit, pending-set) change, not per
-                    # launch — the incremental _sync_device cache still
-                    # covers the base rows
-                    npend = len(self._pending_X)
-                    ntot = n + npend
-                    need = pad_pow2(ntot + 1)
-                    d = self.cube.n_dims
-                    Xa = np.full((need, d), 0.5, np.float32)
-                    ya = np.full(need, np.inf, np.float32)
-                    Xa[:n] = self._Xbuf[:n]
-                    ya[:n] = self._ybuf[:n]
-                    Xa[n:ntot] = np.asarray(self._pending_X, np.float32)
-                    ya[n:ntot] = lie
-                    self._aug_key = aug_key
-                    self._aug_X = jnp.asarray(Xa)
-                    self._aug_y = jnp.asarray(ya)
-                    self._aug_n = ntot
-                X_dev, y_dev = self._aug_X, self._aug_y
-                n_eff = self._aug_n
+        """One kernel launch + one readback covering a request of ``num``.
+
+        Returns the WHOLE pool the launch computed (``pool_w · n_pools``
+        points, ≥ num) — the caller banks the overshoot in the prefetch so
+        later asks at the same fit are served without touching the device.
+
+        The snapshot (buffer sync, pending overlay, PRNG position
+        allocation) happens under the kernel lock; the launch and blocking
+        readback run OUTSIDE it, so observe()/set_pending()/score() are
+        never stalled behind device compute. Requests up to one pool wide
+        launch a single pool of width pad_pow2(num); larger requests batch
+        pad_pow2(ceil(num / pool_w)) pools of the uniform pool width into
+        the SAME program — pool p is keyed fold_in(fit_key, count + p),
+        exactly what p sequential launches would use, so coalesced serving
+        replays the identical stream.
+        """
+        with self._kernel_lock:
+            if self._base_key is None:
+                self._base_key = jax.random.PRNGKey(self._kernel_seed)
+            if self._n_choices_dev is None:
+                self._n_choices_dev = jnp.asarray(
+                    self.cube.n_choices.astype(np.int32))
+                self._cont_mask_dev = jnp.asarray(~self.cube.categorical_mask)
+            self._buf.sync(self._X, self._y)
+            n = len(self._y)
+            if self._pool_n != n:
+                self._pool_n, self._pool_idx = n, 0
+            # pool width is a static (compile-time) shape; pad to pow2 so
+            # the producer's shrinking pool size near max_trials reuses a
+            # compiled variant
+            pool_w = pad_pow2(min(num, self.pool_prefetch), minimum=1)
+            n_pools = 1
+            if num > pool_w:
+                n_pools = pad_pow2(-(-num // pool_w), minimum=1)
+            # key = fold_in(fold_in(base, n_obs), pool_idx): the stream at
+            # one fit never depends on how many (possibly discarded)
+            # launches other fits made — see _pool_n in __init__
+            count = self._pool_idx
+            self._pool_idx += n_pools
+            fit_key = jax.random.fold_in(self._base_key, n)
+            X_dev, y_dev, n_eff = self._buf.Xdev, self._buf.ydev, n
+            if (self._pending_X and self.parallel_strategy is not None
+                    and n > 0):
+                # lie rows ride as extra observations; values derive from
+                # the live fit (mean = neutral, max = pessimistic), so a
+                # completed trial's truth replaces its lie on the next
+                # cycle. NaN objectives (diverged trials, legal input —
+                # argsort sends them to the bad set) must not poison the lie
+                lie = (float(np.nanmean(self._y))
+                       if self.parallel_strategy == "mean"
+                       else float(np.nanmax(self._y)))
+                if np.isfinite(lie):
+                    aug_key = (n, self._pending_fp)
+                    if self._aug_key != aug_key:
+                        # device-side compose: base rows copied on device,
+                        # only the lie rows cross the host→device boundary
+                        Xa, ya, ntot = self._buf.overlay(
+                            self._pending_X, lie)
+                        self._aug_key = aug_key
+                        self._aug_X, self._aug_y = Xa, ya
+                        self._aug_n = ntot
+                    X_dev, y_dev = self._aug_X, self._aug_y
+                    n_eff = self._aug_n
+            g_pad, b_pad = split_pads(n_eff, self.gamma)
+            self._launches += 1
         best = np.asarray(
             tpe_suggest_fused(
                 X_dev, y_dev,
@@ -500,11 +533,14 @@ class TPE(BaseAlgorithm):
                 self._n_choices_dev, self._cont_mask_dev,
                 self.gamma, self.prior_weight, self.full_weight_num,
                 n_cand=self.n_ei_candidates,
-                n_out=n_out,
+                n_out=pool_w,
                 kmax=self._kmax,
                 equal_weight=self.equal_weight,
+                n_good_pad=g_pad,
+                n_bad_pad=b_pad,
+                n_pools=n_pools,
             )
-        )[:num]
+        )
         fid = self.space.fidelity
         out = []
         for row in best:
@@ -539,17 +575,24 @@ class TPE(BaseAlgorithm):
 
     def seed_rng(self, seed: Optional[int]) -> None:
         super().seed_rng(seed)
-        with getattr(self, "_kernel_lock", threading.RLock()):
-            self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
-            self._base_key = None
-            self._pool_n = -1
-            self._pool_idx = 0
-            self._prefetch = []
-            self._prefetch_n_obs = -1
+        # launch → kernel lock order; getattr: called from the base ctor
+        # before the locks exist
+        with getattr(self, "_launch_lock", threading.RLock()):
+            with getattr(self, "_kernel_lock", threading.RLock()):
+                self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
+                self._base_key = None
+                self._pool_n = -1
+                self._pool_idx = 0
+                self._prefetch = []
+                self._prefetch_n_obs = -1
 
     # -- persistence -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
-        with self._kernel_lock:  # waits out an in-flight speculative refill
+        # the launch lock waits out an in-flight speculative refill: its
+        # pool must either commit (and serialize with the state) or not
+        # have allocated its stream position yet — a snapshot taken
+        # mid-launch would make the restored instance skip those points
+        with self._launch_lock, self._kernel_lock:
             s = super().state_dict()
             s["X"] = [x.tolist() for x in self._X]
             s["y"] = list(self._y)
@@ -563,7 +606,7 @@ class TPE(BaseAlgorithm):
             return s
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
-        with self._kernel_lock:
+        with self._launch_lock, self._kernel_lock:
             super().load_state_dict(state)
             self._X = [np.asarray(x, np.float32) for x in state.get("X", [])]
             self._y = list(state.get("y", []))
@@ -573,7 +616,7 @@ class TPE(BaseAlgorithm):
             self._pool_idx = int(
                 state.get("pool_idx", state.get("suggest_count", 0))
             )
-            self._cap = 0          # invalidate device mirror
-            self._n_dev = -1
+            self._buf.reset()      # restored lists may differ at same count
+            self._aug_key = None   # pending overlay may alias (n, fp)
             self._prefetch = [dict(p) for p in state.get("prefetch", [])]
             self._prefetch_n_obs = int(state.get("prefetch_n_obs", -1))

@@ -1658,7 +1658,20 @@ _COMMANDS = {
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args, extras = parser.parse_known_args(argv)
+    if extras:
+        # Python 3.10 argparse leaves trailing positionals unmatched when an
+        # (empty) nargs="*" positional precedes the optionals, as in
+        # `db set -n exp max_trials=50`; reclaim them for the db KEY=VALUE
+        # tail and reject anything else as argparse would.
+        if getattr(args, "command", None) == "db" and all(
+            not e.startswith("-") for e in extras
+        ):
+            args.assignments = list(getattr(args, "assignments", None) or [])
+            args.assignments += extras
+        else:
+            parser.error("unrecognized arguments: %s" % " ".join(extras))
     level = [logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
     logging.basicConfig(
         level=level, format="%(asctime)s %(name)s %(levelname)s %(message)s"

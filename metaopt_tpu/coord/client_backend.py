@@ -242,7 +242,11 @@ class CoordLedgerClient(LedgerBackend):
         worker: Optional[str] = None,
     ) -> Dict[str, Any]:
         """One observe→suggest→register cycle on the coordinator's single
-        hosted algorithm instance; returns {"registered": n, "algo_done"}."""
+        hosted algorithm instance; returns {"registered": n, "algo_done",
+        "coalesced"}. The server may group-commit concurrent produce calls
+        (one combined cycle serves every request in the coalescing window);
+        ``registered`` is then the combined total — a progress signal, not
+        a per-caller count."""
         return self._call(
             "produce", experiment=experiment, pool_size=pool_size, worker=worker
         )

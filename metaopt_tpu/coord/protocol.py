@@ -6,6 +6,13 @@ One message = 4-byte big-endian length + UTF-8 JSON. Requests are
 socket (not pickle) keeps the channel language-neutral and injection-safe;
 trial documents already round-trip through dicts for the file ledger, so the
 same ``to_dict``/``from_dict`` pair is the marshalling layer here.
+
+The ``produce`` op's reply is ``{"registered": int, "algo_done": bool,
+"coalesced": int}``: the server may group-commit concurrent produce requests
+into one combined suggestion cycle (``CoordServer(produce_coalesce_ms=…)``),
+in which case ``registered`` is the combined cycle's total and ``coalesced``
+the number of requests it served — clients must treat ``registered`` as a
+progress signal, not as "trials registered on my behalf alone".
 """
 
 from __future__ import annotations

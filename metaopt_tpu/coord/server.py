@@ -27,6 +27,10 @@ reference either lacked (v0-era warts, SURVEY.md §5) or delegated to Mongo:
   N divergent copies; ``judge`` forwards per-trial early-stop decisions to
   the same instance. Reconstructed by observe-replay after a restart —
   hosted-algorithm state needs no extra persistence beyond the ledger.
+  Concurrent ``produce`` calls group-commit: requests landing within the
+  ``produce_coalesce_ms`` window share ONE observe→suggest→register cycle
+  whose suggest width is the combined request, served from a single fused
+  kernel launch (see :class:`_ProduceCoalescer`).
 """
 
 from __future__ import annotations
@@ -72,6 +76,96 @@ class _LockedLedger:
         return locked
 
 
+class _ProduceCoalescer:
+    """Group-commit for concurrent ``produce`` RPCs on one hosted algorithm.
+
+    N workers that call ``produce`` within one bounded window
+    (``produce_coalesce_ms``) are served by a SINGLE
+    observe→suggest→register cycle whose suggest width is the combined
+    request. The fused surrogate kernels batch that combined width into one
+    launch (TPE packs pad_pow2(ceil(want / pool_w)) pools into the same
+    program — see ``TPE._launch_ei``), so the fixed launch + readback cost
+    is paid once per window instead of once per worker.
+
+    Replay determinism: the combined suggest consumes exactly the PRNG pool
+    positions the member requests would have consumed served one after the
+    other (pool p of a batched launch is keyed ``fold_in(fit_key,
+    count + p)`` — bit-identical to p sequential launches), so coalescing
+    changes latency, never the suggestion stream.
+
+    Every member's reply reports the TOTAL the combined cycle registered
+    plus the member count (``coalesced``). Worker loops use ``registered``
+    only as a progress/idle signal (worker/loop.py), so reporting the group
+    total to each member is benign — and honest: those trials ARE now
+    available for every member to reserve.
+
+    The leader (first request of a window) sleeps the window out, closes
+    the batch, and runs the cycle under the per-experiment producer lock;
+    latecomers open the next batch and pipeline behind it. ``window_s=0``
+    degrades to plain per-request serving (still one-cycle-per-request,
+    just without the wait).
+    """
+
+    class _Batch:
+        __slots__ = ("sizes", "workers", "done", "result", "error", "closed")
+
+        def __init__(self) -> None:
+            self.sizes: list = []
+            self.workers: list = []
+            self.done = threading.Event()
+            self.result: Optional[Dict[str, Any]] = None
+            self.error: Optional[BaseException] = None
+            self.closed = False
+
+    def __init__(self, producer, plock: threading.Lock, window_s: float,
+                 on_cycle=None) -> None:
+        self.producer = producer
+        self.plock = plock
+        self.window_s = window_s
+        self.on_cycle = on_cycle
+        self._guard = threading.Lock()
+        self._open: Optional["_ProduceCoalescer._Batch"] = None
+
+    def produce(self, pool_size: Optional[int],
+                worker: Optional[str] = None) -> Dict[str, Any]:
+        with self._guard:
+            b = self._open
+            leader = b is None or b.closed
+            if leader:
+                b = self._open = self._Batch()
+            b.sizes.append(pool_size)
+            b.workers.append(worker)
+        if not leader:
+            b.done.wait()
+        else:
+            if self.window_s > 0:
+                time.sleep(self.window_s)
+            with self._guard:
+                b.closed = True
+                if self._open is b:
+                    self._open = None
+            try:
+                default = self.producer.experiment.pool_size
+                want = sum(int(ps or default) for ps in b.sizes)
+                with self.plock:
+                    n = self.producer.produce(want)
+                b.result = {
+                    "registered": n,
+                    "algo_done": bool(self.producer.algorithm.is_done),
+                    "coalesced": len(b.sizes),
+                }
+                if self.on_cycle is not None:
+                    self.on_cycle(b)
+            except BaseException as e:
+                b.error = e
+            finally:
+                b.done.set()
+        if b.error is not None:
+            raise b.error
+        assert b.result is not None
+        return dict(b.result)
+
+
 class CoordServer:
     """Serve a ledger backend over TCP; one thread per client connection.
 
@@ -90,6 +184,7 @@ class CoordServer:
         sweep_interval_s: float = 5.0,
         event_log_path: Optional[str] = None,
         host_algorithms: bool = True,
+        produce_coalesce_ms: float = 3.0,
     ) -> None:
         self.inner = inner if inner is not None else MemoryLedger()
         self._bind = (host, port)
@@ -122,6 +217,11 @@ class CoordServer:
         #: ``_lock`` individually via :class:`_LockedLedger`.
         self._producers: Dict[str, Any] = {}
         self._producers_guard = threading.Lock()
+        #: group-commit window for concurrent produce RPCs (0 disables):
+        #: requests arriving within this window share ONE
+        #: observe→suggest→register cycle — see _ProduceCoalescer
+        self.produce_coalesce_ms = produce_coalesce_ms
+        self._coalescers: Dict[str, _ProduceCoalescer] = {}
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -327,7 +427,8 @@ class CoordServer:
     )
 
     def _hosted_producer(self, name: str):
-        """The coordinator-owned (Producer, lock) for an experiment (lazy).
+        """The coordinator-owned (Producer, lock, coalescer) for an
+        experiment (lazy).
 
         After a restart this rebuilds from scratch: the Experiment adopts
         the (restored) ledger doc and the algorithm re-learns everything on
@@ -350,7 +451,23 @@ class CoordServer:
                 algo = make_algorithm(exp.space, exp.algorithm)
                 entry = (Producer(exp, algo), threading.Lock())
                 self._producers[name] = entry
-        return entry
+
+                def on_cycle(batch, _name=name):
+                    res = batch.result or {}
+                    if res.get("registered"):
+                        self._event(
+                            "produce", _name,
+                            registered=res["registered"],
+                            coalesced=res["coalesced"],
+                            workers=[w for w in batch.workers if w],
+                        )
+
+                self._coalescers[name] = _ProduceCoalescer(
+                    entry[0], entry[1],
+                    self.produce_coalesce_ms / 1000.0, on_cycle,
+                )
+            coalescer = self._coalescers[name]
+        return entry[0], entry[1], coalescer
 
     def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         """Reply-cache lookup + dispatch + store under ONE lock hold.
@@ -374,24 +491,20 @@ class CoordServer:
             # ledger dedup exactly like decentralized producer races.
             try:
                 a = msg.get("args") or {}
-                producer, plock = self._hosted_producer(a["experiment"])
-                with plock:
-                    if op == "produce":
-                        n = producer.produce(a.get("pool_size"))
-                        if n:
-                            self._event(
-                                "produce", a["experiment"], registered=n,
-                                worker=a.get("worker"),
-                            )
-                        result: Any = {
-                            "registered": n,
-                            "algo_done": bool(producer.algorithm.is_done),
-                        }
-                    elif op == "judge":
+                producer, plock, coalescer = self._hosted_producer(
+                    a["experiment"])
+                if op == "produce":
+                    # concurrent produce RPCs group-commit: one combined
+                    # cycle per coalescing window (event emitted there)
+                    result: Any = coalescer.produce(
+                        a.get("pool_size"), worker=a.get("worker"))
+                elif op == "judge":
+                    with plock:
                         result = producer.algorithm.judge(
                             Trial.from_dict(a["trial"]), a["partial"]
                         )
-                    else:
+                else:
+                    with plock:
                         result = bool(producer.algorithm.should_suspend(
                             Trial.from_dict(a["trial"])
                         ))
@@ -432,6 +545,7 @@ class CoordServer:
             # in the opposite order (_producers_guard → _lock)
             with self._producers_guard:
                 self._producers.pop((msg.get("args") or {}).get("name"), None)
+                self._coalescers.pop((msg.get("args") or {}).get("name"), None)
             # durability: restore() merges a stale snapshot's docs back in,
             # which would RESURRECT the deleted experiment after a crash —
             # so persist the post-delete state now. Outside _lock: snapshot

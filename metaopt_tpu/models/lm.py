@@ -121,7 +121,9 @@ def make_lm(hparams: Optional[Dict[str, Any]] = None,
 def lm_loss_fn(model, params, tokens, dropout_key,
                moe_aux_weight: float = 0.01):
     """Next-token loss: predict ``tokens[:, 1:]`` from ``tokens[:, :-1]``."""
-    inp, labels = tokens[:, :-1], tokens[:, 1:]
+    from metaopt_tpu.parallel.sharding import pin_batch_layout
+
+    inp, labels = pin_batch_layout(tokens[:, :-1]), tokens[:, 1:]
     blocked = blocked_xent_enabled(
         labels.shape[0], labels.shape[1], model.vocab)
     out, mutated = model.apply(

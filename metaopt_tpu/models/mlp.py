@@ -18,6 +18,12 @@ from flax import linen as nn
 from metaopt_tpu.models.data import synthetic_images
 
 
+def _mxu_dtype():
+    # bf16 matmuls pay off on the MXU; on CPU they are emulated — slower
+    # and noisier than f32.
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
 class MLP(nn.Module):
     width: int
     depth: int
@@ -26,9 +32,10 @@ class MLP(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, train: bool):
-        x = x.reshape((x.shape[0], -1)).astype(jnp.bfloat16)
+        dtype = _mxu_dtype()
+        x = x.reshape((x.shape[0], -1)).astype(dtype)
         for _ in range(self.depth):
-            x = nn.Dense(self.width, dtype=jnp.bfloat16)(x)
+            x = nn.Dense(self.width, dtype=dtype)(x)
             x = nn.relu(x)
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
         return nn.Dense(self.n_classes, dtype=jnp.float32)(x)
@@ -67,19 +74,23 @@ def train_and_eval(
 
     @jax.jit
     def epoch(carry, ekey):
-        def step(c, i):
+        # one permutation per epoch, partitioned into static-shape batches —
+        # every sample is visited exactly once per epoch
+        kperm, kstep = jax.random.split(ekey)
+        idx = jax.random.permutation(kperm, n_train)[: steps * batch_size]
+        idx = idx.reshape(steps, batch_size)
+
+        def step(c, ib):
             p, o, k = c
-            k, dk, sk = jax.random.split(k, 3)
-            # static-shape batch slice from a shuffled index
-            idx = jax.random.permutation(sk, n_train)[: batch_size]
-            xb, yb = x[idx], y[idx]
+            k, dk = jax.random.split(k)
+            xb, yb = x[ib], y[ib]
             loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb, dk)
             updates, o = tx.update(grads, o, p)
             p = optax.apply_updates(p, updates)
             return (p, o, k), loss
 
         (p, o, _), losses = jax.lax.scan(
-            step, (carry[0], carry[1], ekey), jnp.arange(steps)
+            step, (carry[0], carry[1], kstep), idx
         )
         return (p, o), losses.mean()
 

@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from metaopt_tpu.parallel.sharding import with_mesh_partitioning
+
 
 class MoEFeedForward(nn.Module):
     d_model: int
@@ -67,14 +69,14 @@ class MoEFeedForward(nn.Module):
         router = nn.Dense(e, dtype=jnp.float32, name="router")
         wi = self.param(
             "wi",
-            nn.with_partitioning(nn.initializers.lecun_normal(),
-                                 ("ep", None, "tp")),
+            with_mesh_partitioning(nn.initializers.lecun_normal(),
+                                   ("ep", None, "tp")),
             (e, d, f),
         )
         wo = self.param(
             "wo",
-            nn.with_partitioning(nn.initializers.lecun_normal(),
-                                 ("ep", "tp", None)),
+            with_mesh_partitioning(nn.initializers.lecun_normal(),
+                                   ("ep", "tp", None)),
             (e, f, d),
         )
 

@@ -27,7 +27,7 @@ from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metaopt_tpu.models.data import synthetic_seq2seq
-from metaopt_tpu.parallel.sharding import shard_batch
+from metaopt_tpu.parallel.sharding import shard_batch, with_mesh_partitioning
 
 
 def _pinit(partitioned: bool, axes):
@@ -39,7 +39,7 @@ def _pinit(partitioned: bool, axes):
     inside a pp x dp manual mesh is an error, not a no-op.
     """
     init = nn.initializers.lecun_normal()
-    return nn.with_partitioning(init, axes) if partitioned else init
+    return with_mesh_partitioning(init, axes) if partitioned else init
 
 
 class MHA(nn.Module):
@@ -383,9 +383,12 @@ def masked_mean_with_aux(loss, mask, mutated, moe_aux_weight):
 
 
 def loss_fn(model, params, batch, dropout_key, moe_aux_weight: float = 0.01):
+    from metaopt_tpu.parallel.sharding import pin_batch_layout
+
     src, tgt = batch
     bos = jnp.ones((tgt.shape[0], 1), tgt.dtype)
-    tgt_in = jnp.concatenate([bos, tgt[:, :-1]], axis=1)
+    tgt_in = pin_batch_layout(
+        jnp.concatenate([bos, tgt[:, :-1]], axis=1))
     blocked = blocked_xent_enabled(tgt.shape[0], tgt.shape[1], model.vocab)
     out, mutated = model.apply(
         {"params": params}, src, tgt_in, train=True, features=blocked,

@@ -210,7 +210,11 @@ def _cat_tables_device(X, w_sel, n_choices, prior_weight, kmax: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_cand", "n_out", "kmax", "equal_weight")
+    jax.jit,
+    static_argnames=(
+        "n_cand", "n_out", "kmax", "equal_weight",
+        "n_good_pad", "n_bad_pad", "n_pools",
+    ),
 )
 def tpe_suggest_fused(
     X,                   # (N, d) unit-cube observations, padded (N ≥ n+1)
@@ -228,64 +232,112 @@ def tpe_suggest_fused(
     n_out: int,
     kmax: int,
     equal_weight: bool,
+    n_good_pad: int = 0,
+    n_bad_pad: int = 0,
+    n_pools: int = 1,
 ):
-    """A whole suggest pool in ONE device program + ONE host readback.
+    """Whole suggest pools in ONE device program + ONE host readback.
 
-    Scores ``n_out`` independent candidate pools of ``n_cand`` each against a
-    shared l/g fit and returns the per-pool winners, shape (n_out, d). One
-    call per ``suggest(num)`` — essential on tunneled PJRT backends where a
-    blocking device→host readback costs ~70 ms regardless of payload size.
+    Scores ``n_cand`` candidates per output slot against a shared l/g fit
+    and returns the winners, shape (n_pools * n_out, d) — ``n_pools``
+    independent prefetch pools, each keyed ``fold_in(base_key, count + p)``
+    so pool ``p`` draws the EXACT stream a separate launch at stream
+    position ``count + p`` would (counter-based threefry: no state carries
+    between pools). One call serves every pool — essential on tunneled PJRT
+    backends where a blocking device→host readback costs ~70 ms regardless
+    of payload size.
+
+    The good/bad sets are COMPACTED before fitting: the γ-split selects
+    ``n_below`` good rows out of n, so density evaluation runs over
+    ``n_good_pad``/``n_bad_pad`` components (pads of n_below+1 and
+    n−n_below+1, computed host-side from the live count with the same
+    formula as the in-kernel split) instead of 2× the full buffer — at
+    γ=0.25 that cuts the O(C·N·d) inner product roughly in half. Pass
+    0 (default) to fit over the full buffer width.
     """
     npad, d = X.shape
+    if not n_good_pad:
+        n_good_pad = npad
+    if not n_bad_pad:
+        n_bad_pad = npad
     idx = jnp.arange(npad)
 
     # γ-split by objective rank (padding sorts last via +inf)
     order = jnp.argsort(jnp.where(idx < n, y, jnp.inf))
-    rank = jnp.zeros(npad, jnp.int32).at[order].set(idx.astype(jnp.int32))
-    n_below = jnp.maximum(1, jnp.ceil(gamma * n).astype(jnp.int32))
-    good_mask = (rank < n_below) & (idx < n)
-    bad_mask = (rank >= n_below) & (idx < n)
-
+    n_below = jnp.minimum(
+        jnp.maximum(1, jnp.ceil(gamma * n).astype(jnp.int32)),
+        jnp.maximum(n, 1),
+    )
+    # safety clamp: the caller sized n_good_pad from the same formula on the
+    # host; never let a rounding divergence index past the prior row
+    n_below = jnp.minimum(n_below, n_good_pad - 1)
     w_obs = _recency_weights(n, idx, full_weight_num, equal_weight)
-    w_good = jnp.where(good_mask, w_obs, 0.0)
-    w_bad = jnp.where(bad_mask, w_obs, 0.0)
-    ng = good_mask.sum()
-    nb = bad_mask.sum()
+    ng = jnp.minimum(n_below, n)
+    nb = jnp.maximum(n - n_below, 0)
 
-    g_mu, g_sig, g_logw = _fit_set_device(X, w_good, ng, prior_weight)
-    b_mu, b_sig, b_logw = _fit_set_device(X, w_bad, nb, prior_weight)
-    g_cat = _cat_tables_device(X, w_good, n_choices, prior_weight, kmax)
-    b_cat = _cat_tables_device(X, w_bad, n_choices, prior_weight, kmax)
+    # compact gather: good rows are order[0:n_below], bad rows follow
+    gpos = jnp.arange(n_good_pad)
+    gsel = order[jnp.minimum(gpos, npad - 1)]
+    w_good = jnp.where(gpos < ng, w_obs[gsel], 0.0)
+    Xg = X[gsel]
+    bpos = n_below + jnp.arange(n_bad_pad)
+    bsel = order[jnp.minimum(bpos, npad - 1)]
+    w_bad = jnp.where(bpos < n, w_obs[bsel], 0.0)
+    Xb = X[bsel]
 
-    # ---- sample n_out pools of n_cand candidates from the good mixture ----
-    key = jax.random.fold_in(base_key, count)
-    k_comp, k_draw, k_redraw, k_cat = jax.random.split(key, 4)
+    g_mu, g_sig, g_logw = _fit_set_device(Xg, w_good, ng, prior_weight)
+    b_mu, b_sig, b_logw = _fit_set_device(Xb, w_bad, nb, prior_weight)
+    g_cat = _cat_tables_device(Xg, w_good, n_choices, prior_weight, kmax)
+    b_cat = _cat_tables_device(Xb, w_bad, n_choices, prior_weight, kmax)
+
+    # ---- per pool: sample n_out slots of n_cand candidates from l ----
     dim_idx = jnp.arange(d)[None, :]                             # (1, d)
     C = n_out * n_cand
-
-    comp = jax.random.categorical(k_comp, g_logw.T, shape=(C, d))
-    mu_c = g_mu[comp, dim_idx]
-    sig_c = g_sig[comp, dim_idx]
-    draws = mu_c + sig_c * jax.random.normal(k_draw, (C, d))
-    redraw = mu_c + sig_c * jax.random.normal(k_redraw, (C, d))
-    oob = (draws < 0.0) | (draws > 1.0)
-    draws = jnp.clip(jnp.where(oob, redraw, draws), 1e-6, 1.0 - 1e-6)
-
     k = jnp.maximum(n_choices, 1)
     cat_logits = jnp.where(jnp.arange(kmax)[None, :] < k[:, None],
                            g_cat, _NEG_INF)                      # (d, K)
-    cats = jax.random.categorical(k_cat, cat_logits, shape=(C, d))
-    cat_vals = (cats.astype(jnp.float32) + 0.5) / k[None, :]
 
-    cand = jnp.where(cont_mask[None, :], draws, cat_vals)        # (C, d)
-    cand_cat = jnp.minimum((cand * k[None, :]).astype(jnp.int32),
-                           (k - 1)[None, :])
+    outs = []
+    for p in range(n_pools):
+        key = jax.random.fold_in(base_key, count + p)
+        k_comp, k_draw, k_redraw, k_cat = jax.random.split(key, 4)
 
-    # ---- EI ranking: log l(x) - log g(x) ----
-    log_l = _mixture_logpdf(cand, g_mu, g_sig, g_logw)
-    log_g = _mixture_logpdf(cand, b_mu, b_sig, b_logw)
-    log_l = jnp.where(cont_mask[None, :], log_l, g_cat[dim_idx, cand_cat])
-    log_g = jnp.where(cont_mask[None, :], log_g, b_cat[dim_idx, cand_cat])
-    scores = jnp.sum(log_l - log_g, axis=1).reshape(n_out, n_cand)
-    winners = jnp.argmax(scores, axis=1)                         # (n_out,)
-    return cand.reshape(n_out, n_cand, d)[jnp.arange(n_out), winners]
+        comp = jax.random.categorical(k_comp, g_logw.T, shape=(C, d))
+        mu_c = g_mu[comp, dim_idx]
+        sig_c = g_sig[comp, dim_idx]
+        draws = mu_c + sig_c * jax.random.normal(k_draw, (C, d))
+        redraw = mu_c + sig_c * jax.random.normal(k_redraw, (C, d))
+        oob = (draws < 0.0) | (draws > 1.0)
+        draws = jnp.clip(jnp.where(oob, redraw, draws), 1e-6, 1.0 - 1e-6)
+
+        cats = jax.random.categorical(k_cat, cat_logits, shape=(C, d))
+        cat_vals = (cats.astype(jnp.float32) + 0.5) / k[None, :]
+
+        cand = jnp.where(cont_mask[None, :], draws, cat_vals)    # (C, d)
+        cand_cat = jnp.minimum((cand * k[None, :]).astype(jnp.int32),
+                               (k - 1)[None, :])
+
+        # ---- EI ranking: log l(x) - log g(x) ----
+        log_l = _mixture_logpdf(cand, g_mu, g_sig, g_logw)
+        log_g = _mixture_logpdf(cand, b_mu, b_sig, b_logw)
+        log_l = jnp.where(cont_mask[None, :], log_l,
+                          g_cat[dim_idx, cand_cat])
+        log_g = jnp.where(cont_mask[None, :], log_g,
+                          b_cat[dim_idx, cand_cat])
+        scores = jnp.sum(log_l - log_g, axis=1).reshape(n_out, n_cand)
+        winners = jnp.argmax(scores, axis=1)                     # (n_out,)
+        outs.append(
+            cand.reshape(n_out, n_cand, d)[jnp.arange(n_out), winners]
+        )
+    return outs[0] if n_pools == 1 else jnp.concatenate(outs, axis=0)
+
+
+def split_pads(n: int, gamma: float) -> tuple:
+    """Static (n_good_pad, n_bad_pad) for a live count, mirroring the
+    in-kernel γ-split so the compacted fit always has room for the subset
+    plus its prior pseudo-component row. float32 math on purpose — it must
+    round exactly like the traced ``ceil(gamma * n)`` inside the kernel."""
+    n = int(n)
+    n_below = int(np.ceil(np.float32(gamma) * np.float32(n)))
+    n_below = min(max(1, n_below), max(n, 1))
+    return pad_pow2(n_below + 1), pad_pow2(max(n - n_below, 0) + 1)

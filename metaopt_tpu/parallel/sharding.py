@@ -5,12 +5,91 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import jax
+import jax.numpy as jnp
+from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class MeshTolerantPartitioned(nn.Partitioned):
+    """``nn.Partitioned`` that survives partial meshes and flattened inits.
+
+    Model code annotates the FULL parallel surface (tp/ep/...), but a trial
+    mesh may carve out only some axes — the stock box then raises
+    "resource axis not found" instead of replicating. And flax's
+    ``DenseGeneral.kernel_init_wrap`` calls the boxed init with a flattened
+    rank-2 shape and unboxes it BEFORE reshaping back to the rank-3 kernel,
+    which under an active mesh applies a rank-3 constraint to a rank-2
+    value. Both paths are handled here: skip the constraint while value
+    rank and names rank disagree, and prune axis names the active mesh
+    does not have (those dims stay replicated, matching ``_prune_spec``
+    in the jit-init path).
+    """
+
+    def unbox(self, apply_constraint=True):
+        if jnp.ndim(self.value) != len(self.names):
+            return self.value
+        if apply_constraint:
+            from metaopt_tpu.parallel.mesh import active_mesh
+
+            mesh = active_mesh()
+            if mesh is not None:
+                axes = set(mesh.axis_names)
+                pruned, changed = [], False
+                for entry in self.names:
+                    if entry is None:
+                        pruned.append(None)
+                    elif isinstance(entry, (tuple, list)):
+                        kept = tuple(a for a in entry if a in axes)
+                        pruned.append(kept if kept else None)
+                        changed |= kept != tuple(entry)
+                    elif entry not in axes:
+                        pruned.append(None)
+                        changed = True
+                    else:
+                        pruned.append(entry)
+                if changed:
+                    if not any(pruned):
+                        return self.value
+                    return jax.lax.with_sharding_constraint(
+                        self.value, P(*pruned)
+                    )
+        return super().unbox(apply_constraint=apply_constraint)
+
+
+def with_mesh_partitioning(init: Callable, names) -> Callable:
+    """``nn.with_partitioning`` built on :class:`MeshTolerantPartitioned`."""
+
+    def boxed_init(rng, shape, dtype=jnp.float32):
+        return MeshTolerantPartitioned(init(rng, shape, dtype), tuple(names))
+
+    return boxed_init
 
 
 def batch_spec(mesh: Mesh) -> P:
     """Batch-sharded over the dp axis (leading dim), replicated elsewhere."""
     return P("dp") if "dp" in mesh.axis_names else P()
+
+
+def pin_batch_layout(x: jax.Array) -> jax.Array:
+    """Constrain a batch-DERIVED tensor to the canonical batch layout.
+
+    Token tensors produced by shifts/concats (the decoder-input BOS shift,
+    the LM next-token slice) leave GSPMD free to re-partition the embedding
+    gather that consumes them. On composed tp×sp meshes the CPU backend
+    routes that freedom into an unevenly padded reshard whose padding rows
+    poison the lookup with NaN (uninitialized pad × zero mask → NaN under
+    the gather-combine). Pinning the derived tensor to the same
+    ``P("dp", None, ...)`` layout as the batch it came from removes the
+    freedom — and costs nothing, since that is where the data already
+    lives. No-op outside a concrete mesh.
+    """
+    from metaopt_tpu.parallel.mesh import active_mesh
+
+    mesh = active_mesh()
+    if isinstance(mesh, Mesh) and "dp" in mesh.axis_names:
+        spec = P(*(["dp"] + [None] * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return x
 
 
 def shard_batch(mesh: Mesh, batch: Any) -> Any:

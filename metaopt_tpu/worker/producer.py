@@ -159,6 +159,15 @@ class RemoteProducer:
     no redundant per-worker re-fits, no divergent suggestion streams — while
     the decentralized :class:`Producer` remains the fallback for ledger
     backends with no coordinator (memory/file/native).
+
+    Concurrent produce RPCs from different workers may be COALESCED by the
+    server into one combined cycle (one fused suggest launch serves every
+    request in the window). The reply's ``registered`` is then the combined
+    cycle's total — correct for this facade's only consumer, the workon
+    loop, which reads it purely as a progress/idle signal; the
+    ``coalesced`` reply field is surfaced in ``timings["coalesced"]`` (how
+    many of this worker's cycles shared a launch with at least one other
+    request).
     """
 
     def __init__(self, experiment: Experiment, worker: Optional[str] = None):
@@ -172,6 +181,7 @@ class RemoteProducer:
         self.worker = worker
         self.timings: Dict[str, float] = {
             "produce_rpc_s": 0.0, "cycles": 0, "suggested": 0, "remote": 1,
+            "coalesced": 0,
         }
         self.algo_done = False
 
@@ -185,6 +195,8 @@ class RemoteProducer:
         self.timings["produce_rpc_s"] += time.perf_counter() - t0
         self.timings["cycles"] += 1
         self.timings["suggested"] += out["registered"]
+        if int(out.get("coalesced", 1)) > 1:
+            self.timings["coalesced"] += 1
         self.algo_done = bool(out.get("algo_done"))
         return out["registered"]
 

@@ -1,0 +1,142 @@
+"""Property tests for the device-resident incremental observation buffers.
+
+The contract (algo/obs_buffer.py): after every ``sync`` the device arrays
+are BIT-identical to a full host-side rebuild at capacity exactly
+``pad_pow2(n + 1)`` — so the donated-append fast path can never perturb the
+suggestion stream, at any observation count, on either side of a pow2
+boundary.
+"""
+
+import numpy as np
+
+from metaopt_tpu.algo.obs_buffer import _BULK_THRESHOLD, ObservationBuffer
+from metaopt_tpu.ops.tpe_math import pad_pow2
+
+
+def host_rebuild(X_rows, y_vals, d):
+    """What sync's bulk path (and the pre-buffer code) would upload."""
+    n = len(y_vals)
+    need = pad_pow2(n + 1)
+    Xb = np.full((need, d), 0.5, np.float32)
+    yb = np.full((need,), np.inf, np.float32)
+    if n:
+        Xb[:n] = np.stack(X_rows).astype(np.float32, copy=False)
+        yb[:n] = np.asarray(y_vals, np.float32)
+    return Xb, yb
+
+
+class TestIncrementalAppend:
+    def test_bit_identical_to_rebuild_at_every_count(self):
+        # one row at a time through n=1..40 walks cap 2→4→8→16→32→64:
+        # every pow2 boundary (grow + append) must match a from-scratch
+        # rebuild exactly, including the 0.5 / inf padding fill
+        rng = np.random.default_rng(0)
+        d = 5
+        buf = ObservationBuffer(d)
+        X_rows, y_vals = [], []
+        for n in range(1, 41):
+            X_rows.append(rng.random(d).astype(np.float32))
+            # non-finite objectives are legal inputs (diverged trials) and
+            # must round-trip; NaN == NaN under assert_array_equal
+            y_vals.append(float("nan") if n % 7 == 0 else float(rng.normal()))
+            buf.sync(X_rows, y_vals)
+            Xb, yb = host_rebuild(X_rows, y_vals, d)
+            assert buf.n == n and buf.cap == Xb.shape[0]
+            np.testing.assert_array_equal(np.asarray(buf.Xdev), Xb)
+            np.testing.assert_array_equal(np.asarray(buf.ydev), yb)
+
+    def test_bulk_then_incremental_matches(self):
+        # a restore lands >_BULK_THRESHOLD rows at once (bulk upload), then
+        # normal operation appends row-by-row on top of it
+        rng = np.random.default_rng(1)
+        d = 3
+        n0 = _BULK_THRESHOLD + 37
+        X_rows = [rng.random(d).astype(np.float32) for _ in range(n0)]
+        y_vals = [float(v) for v in rng.normal(size=n0)]
+        buf = ObservationBuffer(d)
+        buf.sync(X_rows, y_vals)
+        assert buf.bulk_uploads == 1
+        for _ in range(35):
+            X_rows.append(rng.random(d).astype(np.float32))
+            y_vals.append(float(rng.normal()))
+            buf.sync(X_rows, y_vals)
+        Xb, yb = host_rebuild(X_rows, y_vals, d)
+        np.testing.assert_array_equal(np.asarray(buf.Xdev), Xb)
+        np.testing.assert_array_equal(np.asarray(buf.ydev), yb)
+
+    def test_append_h2d_is_o_of_d(self):
+        # steady state: one observation costs (d+1)·4 bytes of H2D, not a
+        # whole-buffer re-upload — the tentpole's headline transfer claim
+        rng = np.random.default_rng(2)
+        d = 8
+        X_rows = [rng.random(d).astype(np.float32) for _ in range(20)]
+        y_vals = [float(v) for v in rng.normal(size=20)]
+        buf = ObservationBuffer(d)
+        buf.sync(X_rows, y_vals)
+        before = buf.h2d_bytes
+        X_rows.append(rng.random(d).astype(np.float32))
+        y_vals.append(0.25)
+        buf.sync(X_rows, y_vals)  # 21 → cap stays pad_pow2(22) = 32
+        assert buf.h2d_bytes - before == (d + 1) * 4
+        assert buf.appends >= 1
+
+    def test_grow_is_device_side(self):
+        # crossing a capacity boundary reallocates device→device: the H2D
+        # meter must charge only the appended row, never the copied rows
+        rng = np.random.default_rng(3)
+        d = 4
+        X_rows = [rng.random(d).astype(np.float32) for _ in range(15)]
+        y_vals = [float(v) for v in rng.normal(size=15)]
+        buf = ObservationBuffer(d)
+        buf.sync(X_rows, y_vals)  # cap = pad_pow2(16) = 16
+        before, reallocs = buf.h2d_bytes, buf.reallocs
+        X_rows.append(rng.random(d).astype(np.float32))
+        y_vals.append(1.5)
+        buf.sync(X_rows, y_vals)  # 16 rows → cap pad_pow2(17) = 32
+        assert buf.reallocs == reallocs + 1
+        assert buf.h2d_bytes - before == (d + 1) * 4
+
+    def test_shrinking_host_lists_resync_from_scratch(self):
+        rng = np.random.default_rng(4)
+        d = 2
+        X_rows = [rng.random(d).astype(np.float32) for _ in range(10)]
+        y_vals = [float(v) for v in rng.normal(size=10)]
+        buf = ObservationBuffer(d)
+        buf.sync(X_rows, y_vals)
+        # state restore rewinds the host lists: device copy must follow
+        X_rows, y_vals = X_rows[:4], y_vals[:4]
+        buf.sync(X_rows, y_vals)
+        Xb, yb = host_rebuild(X_rows, y_vals, d)
+        assert buf.n == 4
+        np.testing.assert_array_equal(np.asarray(buf.Xdev), Xb)
+        np.testing.assert_array_equal(np.asarray(buf.ydev), yb)
+
+
+class TestOverlay:
+    def test_overlay_matches_host_augmentation(self):
+        # constant-liar rows appended on device == host-built augmentation
+        rng = np.random.default_rng(5)
+        d = 6
+        X_rows = [rng.random(d).astype(np.float32) for _ in range(11)]
+        y_vals = [float(v) for v in rng.normal(size=11)]
+        buf = ObservationBuffer(d)
+        buf.sync(X_rows, y_vals)
+        pend = [rng.random(d).astype(np.float32) for _ in range(4)]
+        lie = 0.75
+        Xa, ya, n_eff = buf.overlay(pend, lie)
+        assert n_eff == 15
+        Xb, yb = host_rebuild(X_rows + pend, y_vals + [lie] * 4, d)
+        np.testing.assert_array_equal(np.asarray(Xa), Xb)
+        np.testing.assert_array_equal(np.asarray(ya), yb)
+
+    def test_overlay_h2d_charges_only_pending_rows(self):
+        rng = np.random.default_rng(6)
+        d = 3
+        X_rows = [rng.random(d).astype(np.float32) for _ in range(30)]
+        y_vals = [float(v) for v in rng.normal(size=30)]
+        buf = ObservationBuffer(d)
+        buf.sync(X_rows, y_vals)
+        before = buf.h2d_bytes
+        pend = [rng.random(d).astype(np.float32) for _ in range(2)]
+        buf.overlay(pend, -1.0)
+        assert buf.h2d_bytes - before == 2 * d * 4 + 2 * 4

@@ -217,14 +217,35 @@ class TestAsyncLatencyMachinery:
         assert after["X"] == before["X"]
 
     def test_uniform_launch_width_beyond_pool(self):
-        # asking for more points than pool_prefetch chains uniform launches
+        # asking for more points than pool_prefetch batches pools into ONE
+        # launch: n_pools = pad_pow2(ceil(10/4)) = 4 pools x 4 wide = 16
+        # points from a single fused call — serve 10, keep 6
         space, tpe = make_tpe(seed=9, pool_prefetch=4)
         for i in range(6):
             tpe.observe([completed(space, {"x": float(i), "c": "a"}, float(i))])
-        pts = tpe.suggest(10)  # 3 launches of 4, serve 10, keep 2
+        launches0 = tpe.telemetry()["kernel_launches"]
+        pts = tpe.suggest(10)
         assert len(pts) == 10
-        assert len(tpe._prefetch) == 2
+        assert len(tpe._prefetch) == 6
+        assert tpe.telemetry()["kernel_launches"] - launches0 == 1
         assert len({space.hash_point(p) for p in pts}) > 1
+
+    def test_batched_pools_bit_identical_to_sequential_singles(self):
+        # one suggest(8) batches 2 pools of width 4 into ONE launch; pool p
+        # is keyed fold_in(fit_key, count + p) — exactly what p sequential
+        # launches would use, so the streams must be BIT-identical
+        space, a = make_tpe(seed=17, pool_prefetch=4)
+        _, b = make_tpe(seed=17, pool_prefetch=4)
+        obs = [completed(space, {"x": float(i) - 3.0, "c": "a"},
+                         float(i % 5)) for i in range(9)]
+        for algo in (a, b):
+            algo.observe(list(obs))
+            t = algo._refill_thread
+            if t is not None:
+                t.join(timeout=60)
+        batched = a.suggest(8)
+        singles = [b.suggest(1)[0] for _ in range(8)]
+        assert batched == singles
 
     def test_stream_invariant_to_refill_timing_across_observes(self):
         # two observe batches in quick succession: run A lets the first
