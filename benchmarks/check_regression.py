@@ -34,6 +34,8 @@ import os
 import sys
 
 METRIC = "tpe_suggest_ms_per_point_10k_obs_pool8"
+#: coordinator control-plane throughput (higher is better, gated inversely)
+COORD_METRIC = "coord_trials_per_s_32w"
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -51,9 +53,11 @@ def load_artifact(path: str) -> dict:
         rec = json.load(f)
     if rec.get("metric") != METRIC or "value" not in rec:
         raise SystemExit(f"{path}: not a {METRIC} bench record")
-    backend = (rec.get("extra") or {}).get("backend") or rec.get("backend")
+    extra = rec.get("extra") or {}
+    backend = extra.get("backend") or rec.get("backend")
+    coord = extra.get(COORD_METRIC)
     return {"value": float(rec["value"]), "backend": backend or "unknown",
-            "path": path}
+            "coord": float(coord) if coord else None, "path": path}
 
 
 def round_baselines() -> list:
@@ -70,7 +74,8 @@ def round_baselines() -> list:
         if parsed.get("metric") == METRIC and "value" in parsed:
             out.append((os.path.basename(path),
                         parsed.get("backend", "unknown"),
-                        float(parsed["value"])))
+                        float(parsed["value"]),
+                        parsed))
     return out
 
 
@@ -88,21 +93,43 @@ def main() -> int:
         print(f"WARNING: artifact is a {art['backend']} run (stale: true) — "
               "the TPU headline was not refreshed; gating CPU-vs-CPU only")
 
+    rc = 0
     matching = [b for b in round_baselines() if b[1] == art["backend"]]
     if not matching:
         print(f"no committed {art['backend']} baseline in BENCH_r*.json — "
               "nothing to gate against (pass)")
-        return 0
-    base_name, _, base_value = matching[-1]
-    ratio = art["value"] / base_value
-    verdict = (f"{METRIC}: {art['value']:.3f} ms vs {base_value:.3f} ms "
-               f"({base_name}, {art['backend']}) → {ratio:.3f}x")
-    if ratio > 1.0 + args.threshold:
-        print(f"FAIL {verdict} — regressed past the "
+    else:
+        base_name, _, base_value, _ = matching[-1]
+        ratio = art["value"] / base_value
+        verdict = (f"{METRIC}: {art['value']:.3f} ms vs {base_value:.3f} ms "
+                   f"({base_name}, {art['backend']}) → {ratio:.3f}x")
+        if ratio > 1.0 + args.threshold:
+            print(f"FAIL {verdict} — regressed past the "
+                  f"{args.threshold:.0%} threshold")
+            rc = 1
+        else:
+            print(f"OK {verdict}")
+
+    # coordinator throughput gate: HIGHER is better, so the fail direction
+    # inverts (new < baseline * (1 - threshold)). A baseline round that
+    # predates the metric, or an artifact missing it, is an informational
+    # pass — the first round recording it must not fail itself
+    coord_bases = [b for b in matching if b[3].get(COORD_METRIC)]
+    if art.get("coord") is None or not coord_bases:
+        print(f"{COORD_METRIC}: artifact or committed baseline missing the "
+              "metric — nothing to gate against (pass)")
+        return rc
+    cb_name, _, _, cb_parsed = coord_bases[-1]
+    coord_base = float(cb_parsed[COORD_METRIC])
+    cratio = art["coord"] / coord_base
+    cverdict = (f"{COORD_METRIC}: {art['coord']:.0f} vs {coord_base:.0f} "
+                f"trials/s ({cb_name}, {art['backend']}) → {cratio:.3f}x")
+    if cratio < 1.0 - args.threshold:
+        print(f"FAIL {cverdict} — throughput regressed past the "
               f"{args.threshold:.0%} threshold")
         return 1
-    print(f"OK {verdict}")
-    return 0
+    print(f"OK {cverdict}")
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""Coordinator RPC-plane scale benchmark: trials/sec at 1/8/32 workers.
+
+`sweep_scale.py` measures one worker's coordination throughput per ledger
+backend; THIS driver measures the coordinator's RPC plane under worker
+fan-in — N threaded workers against one in-process CoordServer — and the
+effect of the fused `worker_cycle` fast path against the serial wire
+sequence it replaced (release_stale → produce → reserve →
+should_suspend → doc + count reads, ~5-9 round-trips per trial).
+
+The server is hosted in-process rather than in a subprocess: CI boxes
+for this repo expose ONE core, where a second interpreter cannot run in
+parallel and only adds context-switch noise (measured: cross-process
+inflated fused p99 from ~6 ms to 420 ms). On one core the fused/serial
+ratio is a pure total-work comparison — per-message framing, JSON,
+dispatch, locking and thread handoffs — which is the conservative floor
+of the win; real multi-core deployments add the round-trip savings on
+top.
+
+Both modes run the SAME workon loop. "serial" reproduces the pre-change
+deployment end to end: the client's capability set is pinned so it
+composes each cycle from individual RPCs, and the server runs legacy
+dispatch (one global lock around every ledger op, no preserialized-reply
+cache) — what `_LockedLedger` did before lock sharding. "fused" is the
+shipped configuration.
+
+The objective is instant and the algorithm is random search (no surrogate
+fit), so the measured trials/sec is pure control-plane: framing, JSON,
+dispatch, locking. The produce group-commit window defaults to 0 to keep
+the comparison free of a fixed sleep floor both modes would pay
+identically (coalescing is covered by sweep_scale + the
+coalesced-vs-serial property tests).
+
+    python benchmarks/coord_scale.py [--workers 1 8 32]
+                                     [--modes serial fused]
+                                     [--trials-per-worker 16] [--save]
+
+Emits one JSON line per (mode, workers) config:
+  {"mode": ..., "workers": N, "trials": ..., "wall_s": ...,
+   "trials_per_s": ..., "rpc_p50_ms": ..., "rpc_p99_ms": ...,
+   "rpcs_per_trial": ..., "op_counts": {...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+SPACE = {
+    "lr": "loguniform(1e-5, 1e-1)",
+    "mom": "uniform(0, 1)",
+}
+
+
+def objective(params):
+    # instant: the benchmark must measure the RPC plane, not the trial
+    return (params["mom"] - 0.9) ** 2
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _make_server(mode: str, produce_coalesce_ms: float):
+    """The coordinator under test; ``serial`` gets the pre-fast-path
+    dispatch shape so the baseline is the pre-change server, not the new
+    server driven serially."""
+    from metaopt_tpu.coord import CoordServer
+
+    if mode == "fused":
+        return CoordServer(produce_coalesce_ms=produce_coalesce_ms)
+
+    class LegacyServer(CoordServer):
+        """PR-1 dispatch: ONE global lock serializing every ledger op
+        (reads included) and no preserialized-reply cache — what
+        `_LockedLedger` did before lock sharding."""
+
+        _CACHED_READS = frozenset()
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            # reads queue behind writers again, as _LockedLedger's did
+            self.ledger._LOCK_FREE = frozenset()
+
+        def _exp_lock(self, name):
+            return self._lock
+
+    return LegacyServer(produce_coalesce_ms=produce_coalesce_ms)
+
+
+def run_scale(
+    workers: int,
+    mode: str = "fused",
+    trials_per_worker: int = 16,
+    pool_size: int = 8,
+    produce_coalesce_ms: float = 0.0,
+    seed: int = 0,
+) -> dict:
+    """One config: N threaded workers drain one experiment through one
+    in-process coordinator; returns the throughput/latency row.
+
+    ``mode="serial"`` is the pre-change deployment (legacy-dispatch
+    server + per-op wire sequence); ``mode="fused"`` the shipped one —
+    same machine, same run, which is what makes the fused/serial ratio a
+    like-for-like RPC-plane comparison.
+    """
+    from metaopt_tpu.coord import CoordLedgerClient
+    from metaopt_tpu.executor import InProcessExecutor
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+    from metaopt_tpu.worker import workon
+
+    if mode not in ("serial", "fused"):
+        raise ValueError(f"unknown mode {mode!r}")
+
+    lat_lock = threading.Lock()
+    latencies: list = []
+    op_counts: dict = {}
+
+    class TimingClient(CoordLedgerClient):
+        """Per-RPC wall-clock over every worker thread (client sockets are
+        per-thread, so one shared instance serves all workers)."""
+
+        def _call(self, op, **args):
+            t0 = time.perf_counter()
+            try:
+                return super()._call(op, **args)
+            finally:
+                dt = time.perf_counter() - t0
+                with lat_lock:
+                    latencies.append(dt)
+                    op_counts[op] = op_counts.get(op, 0) + 1
+
+    max_trials = workers * trials_per_worker
+    server = _make_server(mode, produce_coalesce_ms)
+    server.start()
+    try:
+        host, port = server.address
+        client = TimingClient(host=host, port=port)
+        if mode == "serial":
+            # a pre-worker_cycle coordinator advertises only these; the
+            # client then composes cycles from the serial RPC sequence
+            client._caps = ("count", "fetch_completed_since")
+
+        exp = Experiment(
+            f"coordscale-{mode}-{workers}w",
+            client,
+            space=build_space(SPACE),
+            algorithm={"random": {"seed": seed}},
+            max_trials=max_trials,
+            pool_size=pool_size,
+        ).configure()
+        # warm the hosted-producer path (algorithm construction + its
+        # imports) before the clock: the first produce of a fresh process
+        # otherwise pays a one-time ~100s-of-ms setup inside whichever
+        # mode's window runs first — registers one normal pool that the
+        # workers then drain as part of the run
+        client.produce(exp.name, pool_size)
+
+        # worker Experiments are built (1 doc load each) before the clock
+        # starts; the measured window is pure drain
+        worker_exps = [
+            Experiment(exp.name, client).configure() for _ in range(workers)
+        ]
+        threads = []
+        # start the window with an empty collector debt: on a one-core box
+        # a GC pause lands entirely inside whichever mode's window it hits
+        gc.collect()
+        t0 = time.perf_counter()
+        for i, wexp in enumerate(worker_exps):
+            w = threading.Thread(
+                target=workon,
+                args=(wexp, InProcessExecutor(objective)),
+                kwargs={
+                    "worker_id": f"cs-w{i}",
+                    "producer_mode": "coord",
+                    "max_idle_cycles": 2000,
+                    "idle_sleep_s": 0.002,
+                },
+                daemon=True,
+            )
+            w.start()
+            threads.append(w)
+        for w in threads:
+            w.join(timeout=300)
+        wall = time.perf_counter() - t0
+
+        # measurement reads (this count + the lat snapshot) come AFTER the
+        # window closes and are excluded from the RPC accounting
+        with lat_lock:
+            lat_sorted = sorted(latencies)
+            ops = dict(op_counts)
+        n_calls = sum(ops.values())
+        completed = client.count(exp.name, "completed")
+        # steady-state RPCs per trial: one-time ramp excluded — the caps
+        # probe ping, the experiment create/config round-trips, the main
+        # experiment's configure load + warmup produce, and each worker's
+        # bootstrap (configure's doc load + the first loop iteration's
+        # full is_done evaluation: doc load + 2 counts) — an identical
+        # allowance for both modes
+        ramp = (ops.get("ping", 0) + ops.get("create_experiment", 0)
+                + ops.get("update_experiment", 0) + 2 + 4 * workers)
+        steady = max(0, n_calls - ramp)
+        return {
+            "mode": mode,
+            "workers": workers,
+            "trials": completed,
+            "wall_s": round(wall, 3),
+            "trials_per_s": round(completed / wall, 2) if wall else None,
+            "rpc_p50_ms": round(
+                1e3 * statistics.median(lat_sorted), 3) if lat_sorted else None,
+            "rpc_p99_ms": round(
+                1e3 * _percentile(lat_sorted, 0.99), 3) if lat_sorted else None,
+            "rpcs": n_calls,
+            "rpcs_per_trial": round(steady / completed, 2) if completed else None,
+            "op_counts": ops,
+            "enc_cache_hits": server._enc_hits if mode == "fused" else None,
+        }
+    finally:
+        server.stop()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", nargs="*", type=int, default=[1, 8, 32])
+    ap.add_argument("--modes", nargs="*", default=["serial", "fused"])
+    ap.add_argument("--trials-per-worker", type=int, default=16)
+    ap.add_argument("--produce-coalesce-ms", type=float, default=0.0)
+    ap.add_argument(
+        "--repeats", type=int, default=1,
+        help="runs per config; the median-throughput row is reported "
+             "(one-core boxes jitter ±10%% run to run)",
+    )
+    ap.add_argument("--save", action="store_true")
+    args = ap.parse_args()
+
+    from metaopt_tpu.utils.provenance import provenance
+
+    rows = []
+    for n in args.workers:
+        # interleave the modes within each repeat, alternating which goes
+        # first: a long-lived process speeds up run over run (allocator and
+        # cache warm-up), so consecutive same-mode repeats would hand the
+        # later-scheduled mode a systematic advantage
+        per_mode: dict = {m: [] for m in args.modes}
+        errors: dict = {}
+        for r in range(max(1, args.repeats)):
+            order = (list(args.modes) if r % 2 == 0
+                     else list(reversed(args.modes)))
+            for mode in order:
+                try:
+                    per_mode[mode].append(run_scale(
+                        n, mode=mode,
+                        trials_per_worker=args.trials_per_worker,
+                        produce_coalesce_ms=args.produce_coalesce_ms,
+                    ))
+                except Exception as err:
+                    errors[mode] = f"{type(err).__name__}: {err}"
+        for mode in args.modes:
+            reps = sorted(per_mode[mode],
+                          key=lambda r: r["trials_per_s"] or 0)
+            if not reps:
+                row = {"mode": mode, "workers": n,
+                       "error": errors.get(mode, "no successful runs")}
+            else:
+                row = reps[len(reps) // 2]  # median by throughput
+                if len(reps) > 1:
+                    row["repeats"] = len(reps)
+                    row["trials_per_s_all"] = [
+                        r["trials_per_s"] for r in reps
+                    ]
+            row.update(provenance())
+            print(json.dumps(row), flush=True)
+            rows.append(row)
+    # the headline ratio the regression gate rides on: fused vs serial at
+    # the widest fan-in measured in the SAME run on the SAME machine
+    widest = max(args.workers) if args.workers else 0
+    by = {(r.get("mode"), r.get("workers")): r for r in rows}
+    f, s = by.get(("fused", widest)), by.get(("serial", widest))
+    if f and s and f.get("trials_per_s") and s.get("trials_per_s"):
+        print(json.dumps({
+            "summary": f"fused_vs_serial_{widest}w",
+            "speedup": round(f["trials_per_s"] / s["trials_per_s"], 2),
+            "fused_trials_per_s": f["trials_per_s"],
+            "serial_trials_per_s": s["trials_per_s"],
+            "fused_rpcs_per_trial": f.get("rpcs_per_trial"),
+            "serial_rpcs_per_trial": s.get("rpcs_per_trial"),
+        }), flush=True)
+    if args.save:
+        stamp = time.strftime("%Y-%m-%d")
+        path = os.path.join(REPO, "benchmarks", "results",
+                            f"coord_scale_{stamp}.jsonl")
+        with open(path, "a") as fh:
+            for row in rows:
+                fh.write(json.dumps(row) + "\n")
+        print(f"saved -> {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -81,6 +81,12 @@ class CoordLedgerClient(LedgerBackend):
             )
         self.reconnect_window_s = float(reconnect_window_s)
         self._local = threading.local()
+        #: optional-op capabilities advertised by the server's ping reply;
+        #: None until the first probe. A modern server lists them up front
+        #: ("caps"); against an older server this stays an empty tuple and
+        #: every optional op degrades per-op on "unknown op" instead.
+        self._caps: Optional[tuple] = None
+        self._caps_lock = threading.Lock()
 
     # -- connection management --------------------------------------------
     def _sock(self) -> socket.socket:
@@ -137,7 +143,23 @@ class CoordLedgerClient(LedgerBackend):
         raise exc(reply["msg"])
 
     def ping(self) -> Dict[str, Any]:
-        return self._call("ping")
+        r = self._call("ping")
+        with self._caps_lock:
+            self._caps = tuple(r.get("caps") or ())
+        return r
+
+    def _has_cap(self, cap: str) -> bool:
+        """Does the server advertise ``cap``? Probes with one ping on first
+        use; a pre-caps server (no "caps" in its ping reply) reports
+        nothing, and callers then rely on per-op "unknown op" degradation
+        for anything they still optimistically try."""
+        if self._caps is None:
+            try:
+                self.ping()
+            except CoordRPCError:
+                with self._caps_lock:
+                    self._caps = ()
+        return cap in (self._caps or ())
 
     # -- experiment docs ---------------------------------------------------
     def create_experiment(self, config: Dict[str, Any]) -> None:
@@ -250,6 +272,111 @@ class CoordLedgerClient(LedgerBackend):
         return self._call(
             "produce", experiment=experiment, pool_size=pool_size, worker=worker
         )
+
+    def worker_cycle(
+        self,
+        experiment: str,
+        worker: str,
+        pool_size: Optional[int] = None,
+        stale_timeout_s: Optional[float] = None,
+        produce: bool = True,
+        complete: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """One fused worker trial cycle in a single round-trip.
+
+        Server-side this runs the deferred result push (``complete``: a
+        ``{"trial": doc, "expected_status", "expected_worker"}`` payload
+        applied with ``update_trial`` semantics before everything else) →
+        sweep (when ``stale_timeout_s`` is given) → produce (through the
+        shared coalescer — bit-identical suggestion stream to serial
+        serving) → reserve → counts/doneness, and the reply carries
+        everything the workon loop needs for the cycle: ``{"trial",
+        "registered", "algo_done", "coalesced", "released", "signal",
+        "suspend", "max_trials", "exp_algo_done", "counts",
+        "completed_ok", "fused"}`` (``trial`` already a :class:`Trial`,
+        ``fused`` added client-side: False means this reply was composed
+        from serial RPCs against a server without the op, so per-reply
+        fields like ``signal`` are best-effort there).
+
+        Mirrors the ``count``/``fetch_completed_since`` rolling-upgrade
+        doctrine: the op is taken only when the server advertises it (ping
+        ``caps``) and still degrades per-op on "unknown op", so mixed-
+        version pods keep working in both directions.
+        """
+        if self._has_cap("worker_cycle"):
+            try:
+                r = self._call(
+                    "worker_cycle", experiment=experiment, worker=worker,
+                    pool_size=pool_size, stale_timeout_s=stale_timeout_s,
+                    produce=produce, complete=complete,
+                )
+            except CoordRPCError as err:
+                if "unknown op" not in str(err):
+                    raise
+                # caps lied (e.g. a proxy answered the ping): degrade and
+                # stop advertising to ourselves
+                with self._caps_lock:
+                    self._caps = tuple(
+                        c for c in (self._caps or ()) if c != "worker_cycle"
+                    )
+            else:
+                r["trial"] = (
+                    Trial.from_dict(r["trial"]) if r.get("trial") else None
+                )
+                r["fused"] = True
+                return r
+        return self._worker_cycle_serial(
+            experiment, worker, pool_size, stale_timeout_s, produce, complete
+        )
+
+    def _worker_cycle_serial(
+        self,
+        experiment: str,
+        worker: str,
+        pool_size: Optional[int],
+        stale_timeout_s: Optional[float],
+        produce: bool,
+        complete: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """The same cycle as individual RPCs — the pre-``worker_cycle``
+        wire sequence, packaged in the fused reply shape so the workon
+        loop has exactly one coord-mode code path."""
+        out: Dict[str, Any] = {
+            "released": 0, "registered": 0, "algo_done": False,
+            "coalesced": 0, "trial": None, "signal": None, "suspend": False,
+            "completed_ok": None, "fused": False,
+        }
+        if complete:
+            # the deferred result push, as its own RPC — same order the
+            # fused cycle applies it (before sweep/produce/reserve)
+            out["completed_ok"] = bool(self._call(
+                "update_trial", trial=complete["trial"],
+                expected_status=complete.get("expected_status", "reserved"),
+                expected_worker=complete.get("expected_worker"),
+            ))
+        if stale_timeout_s is not None:
+            out["released"] = len(
+                self.release_stale(experiment, float(stale_timeout_s))
+            )
+        if produce:
+            pres = self.produce(experiment, pool_size=pool_size, worker=worker)
+            out["registered"] = pres["registered"]
+            out["algo_done"] = bool(pres.get("algo_done"))
+            out["coalesced"] = pres.get("coalesced", 0)
+        t = self.reserve(experiment, worker)
+        out["trial"] = t
+        if t is not None:
+            out["suspend"] = self.should_suspend(experiment, t)
+        doc = self.load_experiment(experiment)
+        if doc is None:
+            raise KeyError(f"experiment {experiment!r} not found")
+        out["max_trials"] = doc.get("max_trials")
+        out["exp_algo_done"] = bool(doc.get("algo_done"))
+        out["counts"] = {
+            s: self.count(experiment, s)
+            for s in ("new", "reserved", "completed")
+        }
+        return out
 
     def judge(
         self, experiment: str, trial: Trial, partial: List[Dict[str, Any]]

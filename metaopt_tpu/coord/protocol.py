@@ -13,6 +13,18 @@ into one combined suggestion cycle (``CoordServer(produce_coalesce_ms=…)``),
 in which case ``registered`` is the combined cycle's total and ``coalesced``
 the number of requests it served — clients must treat ``registered`` as a
 progress signal, not as "trials registered on my behalf alone".
+
+The ``worker_cycle`` op fuses one whole worker trial cycle server-side
+(stale sweep → produce → reserve → counts) into a single round-trip; a
+server advertises it (and the other optional ops) via ``caps`` in the
+``ping`` reply so clients can pick the fast path up front, and clients
+additionally degrade per-op on an ``unknown op`` error for rolling
+upgrades (see ``CoordLedgerClient.worker_cycle``).
+
+A reply may be served as preencoded bytes (:func:`send_payload`) when the
+server's per-commit reply cache hits — the wire format is identical, the
+JSON encode is just paid once per ledger mutation instead of once per
+observer.
 """
 
 from __future__ import annotations
@@ -30,11 +42,23 @@ class ProtocolError(RuntimeError):
     pass
 
 
-def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
+def encode_msg(msg: Dict[str, Any]) -> bytes:
+    """One message as wire payload bytes (sans length header)."""
     payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
     if len(payload) > MAX_MSG_BYTES:
         raise ProtocolError(f"message too large: {len(payload)} bytes")
+    return payload
+
+
+def send_payload(sock: socket.socket, payload: bytes) -> None:
+    """Send pre-encoded payload bytes — the preserialized-reply fast path."""
+    if len(payload) > MAX_MSG_BYTES:
+        raise ProtocolError(f"message too large: {len(payload)} bytes")
     sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
+    send_payload(sock, encode_msg(msg))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

@@ -3,7 +3,7 @@
 Replaces the reference's MongoDB bus (SURVEY.md §2.7): instead of N workers
 racing on atomic document ops, one process owns a
 :class:`~metaopt_tpu.ledger.backends.LedgerBackend` and serializes every
-mutation under one lock. Workers connect with
+mutation — per experiment, not globally — while workers connect with
 :class:`~metaopt_tpu.coord.client_backend.CoordLedgerClient`.
 
 Beyond plain CRUD forwarding the server owns three pod-level duties the
@@ -31,47 +31,118 @@ reference either lacked (v0-era warts, SURVEY.md §5) or delegated to Mongo:
   ``produce_coalesce_ms`` window share ONE observe→suggest→register cycle
   whose suggest width is the combined request, served from a single fused
   kernel launch (see :class:`_ProduceCoalescer`).
+
+The RPC plane itself is built for many workers against one coordinator:
+
+- **Per-experiment locking** (:class:`_ShardedLedger`): each mutation
+  serializes only against its own experiment; the read ops (``fetch`` /
+  ``count`` / ``fetch_completed_since`` / ``get``) take no server lock at
+  all and ride the backend's own fine-grained locking, so observers never
+  queue behind a writer's event-log append or reply bookkeeping.
+- **Preserialized replies**: hot read replies are JSON-encoded ONCE per
+  ledger commit (a per-experiment mutation counter keys the cache) and
+  served as raw bytes to every observer at the same cursor — N workers
+  observing one experiment cost one encode, not N.
+- **Fused worker cycles**: the ``worker_cycle`` op runs a whole worker
+  trial cycle (stale sweep → produce → reserve → counts/doneness) in one
+  round-trip — see :meth:`CoordServer._worker_cycle`.
+- **Pipelined connections**: each connection's replies are written by a
+  dedicated sender thread, so the next request is decoded and dispatched
+  while the previous (possibly MB-sized fetch) reply drains to the socket.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
+import queue
 import socket
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple, Union
 
-from metaopt_tpu.coord.protocol import ProtocolError, recv_msg, send_msg
+from metaopt_tpu.coord.protocol import (
+    ProtocolError,
+    encode_msg,
+    recv_msg,
+    send_msg,
+    send_payload,
+)
 from metaopt_tpu.ledger.backends import LedgerBackend, MemoryLedger
 from metaopt_tpu.ledger.trial import Trial
 
 log = logging.getLogger(__name__)
 
+#: optional ops this build serves, advertised in the ``ping`` reply so a
+#: client can pick its fast paths up front instead of probe-by-error
+CAPS = ("count", "fetch_completed_since", "worker_cycle")
 
-class _LockedLedger:
-    """Proxy that takes the server's global lock around each ledger op.
 
-    Lets the hosted Producer run its expensive algorithm fit OUTSIDE the
-    global lock while every individual ledger access still serializes with
-    the RPC dispatch path — preserving the single-writer guarantee without
-    holding the control plane hostage to a KDE fit.
+class _ShardedLedger:
+    """Proxy that takes the server's PER-EXPERIMENT lock around each op.
+
+    Successor of the PR-1 ``_LockedLedger`` (one global RLock): ops on
+    different experiments no longer serialize against each other, and the
+    hosted Producer's expensive algorithm fit still runs outside every
+    ledger lock — each of its individual ledger accesses re-enters only
+    its own experiment's lock. Mutating calls bump the server's
+    per-experiment mutation counter, which is what invalidates the
+    preserialized-reply cache.
     """
 
-    def __init__(self, inner: LedgerBackend, lock: threading.RLock) -> None:
+    #: methods whose experiment rides on a Trial argument
+    _TRIAL_ARG = frozenset({"register", "update_trial"})
+    #: pure reads served WITHOUT any server lock: each is a single
+    #: internally-atomic backend call (MemoryLedger holds its own RLock,
+    #: FileLedger its per-experiment flock), so an observer gets a
+    #: consistent per-call snapshot without queueing behind a writer
+    _LOCK_FREE = frozenset({
+        "get", "fetch", "count", "fetch_completed_since",
+        "load_experiment", "list_experiments", "export_docs",
+    })
+    #: methods after which cached encoded replies must not be served.
+    #: ``heartbeat`` is deliberately absent: it only refreshes a liveness
+    #: timestamp, and counting it would bust the reply cache dozens of
+    #: times a second for data no consumer treats as authoritative (the
+    #: stale sweep reads live docs, never cached replies).
+    _MUTATORS = frozenset({
+        "create_experiment", "update_experiment", "delete_experiment",
+        "register", "reserve", "update_trial", "release_stale",
+    })
+
+    def __init__(self, inner: LedgerBackend, server: "CoordServer") -> None:
         self._inner = inner
-        self._lock = lock
+        self._server = server
+
+    def _exp_of(self, method: str, args, kwargs) -> Optional[str]:
+        if method in self._TRIAL_ARG:
+            t = args[0] if args else kwargs.get("trial")
+            return getattr(t, "experiment", None)
+        if method == "create_experiment":
+            cfg = (args[0] if args else kwargs.get("config")) or {}
+            return cfg.get("name")
+        if args and isinstance(args[0], str):
+            return args[0]
+        return kwargs.get("experiment") or kwargs.get("name")
 
     def __getattr__(self, name: str):
         attr = getattr(self._inner, name)
         if not callable(attr):
             return attr
 
+        if name in self._LOCK_FREE:
+            return attr
+
         def locked(*args: Any, **kwargs: Any) -> Any:
-            with self._lock:
-                return attr(*args, **kwargs)
+            exp = self._exp_of(name, args, kwargs)
+            with self._server._exp_lock(exp):
+                out = attr(*args, **kwargs)
+                if name in self._MUTATORS:
+                    self._server._mutated(exp)
+                return out
 
         return locked
 
@@ -91,7 +162,9 @@ class _ProduceCoalescer:
     positions the member requests would have consumed served one after the
     other (pool p of a batched launch is keyed ``fold_in(fit_key,
     count + p)`` — bit-identical to p sequential launches), so coalescing
-    changes latency, never the suggestion stream.
+    changes latency, never the suggestion stream. The ``worker_cycle`` op
+    funnels its produce leg through the same coalescer, so fused cycles
+    inherit the identical guarantee.
 
     Every member's reply reports the TOTAL the combined cycle registered
     plus the member count (``coalesced``). Worker loops use ``registered``
@@ -169,7 +242,8 @@ class _ProduceCoalescer:
 class CoordServer:
     """Serve a ledger backend over TCP; one thread per client connection.
 
-    All ledger ops run under ``self._lock`` — the single-writer guarantee.
+    Every mutating ledger op runs under its experiment's lock (the
+    single-writer guarantee, sharded); reads take no server lock.
     ``port=0`` binds an ephemeral port (tests); ``.address`` reports it.
     """
 
@@ -194,27 +268,57 @@ class CoordServer:
         self.sweep_interval_s = sweep_interval_s
         self.event_log_path = event_log_path
 
+        #: global fallback lock — restore() and ops that name no experiment
         self._lock = threading.RLock()
+        #: per-experiment RLocks, created on demand and never popped (a
+        #: popped lock under a blocked waiter forks its identity — same
+        #: doctrine as the file ledger's persistent lock files)
+        self._exp_locks: Dict[str, threading.RLock] = {}
+        self._exp_locks_guard = threading.Lock()
         self._snap_lock = threading.Lock()  # serializes snapshot file writes
         self._signals: Dict[Tuple[str, str], str] = {}  # (exp, trial_id) → signal
+        self._sig_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._conns: set = set()  # live client connections (for stop())
         self._threads: list[threading.Thread] = []
         self._stopping = threading.Event()
+        self._op_counter = itertools.count(1)  # next() is GIL-atomic
         self._ops = 0
         #: reply cache keyed by client request id — answers retries of calls
         #: whose reply was lost to a dropped connection without re-executing
-        #: them (exactly-once semantics for reserve & co.)
+        #: them (exactly-once semantics for reserve, worker_cycle & co.)
         self._replies: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
         self._replies_cap = 4096
+        self._replies_lock = threading.Lock()
+        #: worker_cycle requests mid-execution, keyed by request id: a retry
+        #: arriving while the original still runs must wait for ITS reply,
+        #: not re-run the embedded reserve (the sharded locks no longer
+        #: serialize the whole dispatch, so the serial path's
+        #: lock-then-cache idiom doesn't cover a multi-op cycle)
+        self._inflight: Dict[str, threading.Event] = {}
+        self._inflight_lock = threading.Lock()
+        #: per-experiment ledger mutation counter — the preserialized-reply
+        #: cache key. Bumped by _ShardedLedger under the experiment's lock.
+        self._mut: Dict[str, int] = {}
+        #: (op, experiment, args-key) → (mut counter, encoded reply bytes).
+        #: N observers at the same cursor are served the SAME bytes; any
+        #: commit to the experiment bumps the counter and the next read
+        #: re-executes + re-encodes exactly once.
+        self._enc_cache: "OrderedDict[tuple, Tuple[int, bytes]]" = OrderedDict()
+        self._enc_cap = 128
+        self._enc_lock = threading.Lock()
+        self._enc_hits = 0
+        #: every ledger access (dispatch AND hosted producers) goes through
+        #: the sharded proxy so locking + cache invalidation can't diverge
+        self.ledger = _ShardedLedger(self.inner, self)
         self.host_algorithms = host_algorithms
         #: experiment → (Producer, per-experiment lock). One algorithm
         #: instance shared by every worker that delegates suggestion here;
-        #: the per-experiment lock serializes produce/judge on it WITHOUT
-        #: holding the global ledger lock across an algorithm fit (which
+        #: the per-experiment producer lock serializes produce/judge on it
+        #: WITHOUT holding any ledger lock across an algorithm fit (which
         #: would stall heartbeats long enough for the stale sweep to
         #: reclaim live reservations) — the Producer's ledger ops re-enter
-        #: ``_lock`` individually via :class:`_LockedLedger`.
+        #: the experiment's lock individually via :class:`_ShardedLedger`.
         self._producers: Dict[str, Any] = {}
         self._producers_guard = threading.Lock()
         #: group-commit window for concurrent produce RPCs (0 disables):
@@ -222,6 +326,21 @@ class CoordServer:
         #: observe→suggest→register cycle — see _ProduceCoalescer
         self.produce_coalesce_ms = produce_coalesce_ms
         self._coalescers: Dict[str, _ProduceCoalescer] = {}
+
+    # -- locks / cache plumbing --------------------------------------------
+    def _exp_lock(self, name: Optional[str]) -> threading.RLock:
+        if not name:
+            return self._lock
+        with self._exp_locks_guard:
+            lk = self._exp_locks.get(name)
+            if lk is None:
+                lk = self._exp_locks[name] = threading.RLock()
+            return lk
+
+    def _mutated(self, name: Optional[str]) -> None:
+        """Record a commit against ``name`` (caller holds its exp lock)."""
+        if name:
+            self._mut[name] = self._mut.get(name, 0) + 1
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -302,13 +421,12 @@ class CoordServer:
                 self.stale_timeout_s is not None
                 and time.time() - last_sweep >= self.sweep_interval_s
             ):
-                with self._lock:
-                    for name in self.inner.list_experiments():
-                        released = self.inner.release_stale(
-                            name, self.stale_timeout_s
-                        )
-                        for t in released:
-                            self._event("release_stale", name, trial=t.id)
+                for name in self.inner.list_experiments():
+                    released = self.ledger.release_stale(
+                        name, self.stale_timeout_s
+                    )
+                    for t in released:
+                        self._event("release_stale", name, trial=t.id)
                 last_sweep = time.time()
             if (
                 self.snapshot_path
@@ -324,26 +442,31 @@ class CoordServer:
 
         ``_snap_lock`` covers capture AND write: the housekeeping thread and
         ``stop()`` may snapshot concurrently, and interleaving their
-        capture/write phases could commit an older capture last.
+        capture/write phases could commit an older capture last. Capture is
+        per-experiment-consistent (each experiment exported under its own
+        lock) rather than a global point-in-time — restore() merges by doc
+        id, so cross-experiment skew is benign, and writers on OTHER
+        experiments are never stalled by a multi-MB capture.
         """
         with self._snap_lock:
-            with self._lock:
-                state = {
-                    "version": 1,
-                    "ts": time.time(),
-                    "experiments": {
-                        name: self.inner.load_experiment(name)
-                        for name in self.inner.list_experiments()
-                    },
-                    "trials": {
-                        name: self.inner.export_docs(name)
-                        for name in self.inner.list_experiments()
-                    },
-                    "signals": [
-                        {"experiment": e, "trial": t, "signal": s}
-                        for (e, t), s in self._signals.items()
-                    ],
-                }
+            experiments: Dict[str, Any] = {}
+            trials: Dict[str, Any] = {}
+            for name in self.inner.list_experiments():
+                with self._exp_lock(name):
+                    experiments[name] = self.inner.load_experiment(name)
+                    trials[name] = self.inner.export_docs(name)
+            with self._sig_lock:
+                signals = [
+                    {"experiment": e, "trial": t, "signal": s}
+                    for (e, t), s in self._signals.items()
+                ]
+            state = {
+                "version": 1,
+                "ts": time.time(),
+                "experiments": experiments,
+                "trials": trials,
+                "signals": signals,
+            }
             tmp = path + ".tmp"
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             with open(tmp, "w") as f:
@@ -363,8 +486,12 @@ class CoordServer:
                 for doc in docs:
                     if doc["id"] not in have:
                         self.inner.register(Trial.from_dict(doc))
-            for sig in state.get("signals", []):
-                self._signals[(sig["experiment"], sig["trial"])] = sig["signal"]
+                with self._exp_lock(name):
+                    self._mutated(name)
+            with self._sig_lock:
+                for sig in state.get("signals", []):
+                    self._signals[(sig["experiment"], sig["trial"])] = (
+                        sig["signal"])
         log.info("restored %d experiments from %s", len(state["experiments"]), path)
 
     # -- event log ---------------------------------------------------------
@@ -394,10 +521,37 @@ class CoordServer:
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        """Pipelined per-connection loop: a dedicated sender thread writes
+        replies while this thread decodes and dispatches the NEXT request,
+        so a client streaming pipelined requests overlaps its reply
+        serialization with server-side work. Reply order is preserved (one
+        FIFO queue, one sender)."""
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._conns.add(conn)
+        outbox: "queue.Queue" = queue.Queue(maxsize=256)
+        dead = threading.Event()
+
+        def _sender() -> None:
+            while True:
+                item = outbox.get()
+                if item is None:
+                    return
+                if dead.is_set():
+                    continue  # drain: never block the recv loop on a dead peer
+                try:
+                    if isinstance(item, (bytes, bytearray)):
+                        send_payload(conn, item)
+                    else:
+                        send_msg(conn, item)
+                except (ConnectionError, BrokenPipeError, OSError,
+                        ProtocolError):
+                    dead.set()
+
+        sender = threading.Thread(
+            target=_sender, name="coord-conn-send", daemon=True)
+        sender.start()
         try:
-            while not self._stopping.is_set():
+            while not self._stopping.is_set() and not dead.is_set():
                 try:
                     msg = recv_msg(conn)
                 except (ProtocolError, ConnectionError, OSError,
@@ -405,26 +559,39 @@ class CoordServer:
                     return
                 if msg is None or self._stopping.is_set():
                     return  # drop, don't ack: stop() snapshots after this
-                reply = self._handle(msg)
-                try:
-                    send_msg(conn, reply)
-                except (ConnectionError, BrokenPipeError, OSError):
-                    return
+                outbox.put(self._handle(msg))
         finally:
+            outbox.put(None)
             self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
                 pass
+            sender.join(timeout=2)
 
     #: ops where a blind retry would double-execute; their replies are cached
     #: by request id. Read-only ops re-execute harmlessly and are not cached
-    #: (a fetch reply on a big experiment is MBs — caching those pins memory).
+    #: by request id (the hot fetch replies are instead cached as encoded
+    #: bytes keyed by the mutation counter — see _enc_cache).
     _MUTATING_OPS = frozenset(
         {"create_experiment", "update_experiment", "delete_experiment",
          "register", "reserve", "update_trial", "release_stale",
          "set_signal"}
     )
+    #: read replies preserialized once per commit and shared by observers
+    _CACHED_READS = frozenset({"fetch", "fetch_completed_since"})
+
+    def _op_lock(self, op: str, a: Dict[str, Any]) -> threading.RLock:
+        """The experiment lock a mutating op must hold across its
+        cache-check + execute + cache-store (same name derivation as
+        :meth:`_ShardedLedger._exp_of`, so the proxy re-enters it)."""
+        if op == "create_experiment":
+            name = (a.get("config") or {}).get("name")
+        elif op in ("register", "update_trial"):
+            name = (a.get("trial") or {}).get("experiment")
+        else:
+            name = a.get("experiment") or a.get("name")
+        return self._exp_lock(name)
 
     def _hosted_producer(self, name: str):
         """The coordinator-owned (Producer, lock, coalescer) for an
@@ -440,16 +607,30 @@ class CoordServer:
         with self._producers_guard:
             entry = self._producers.get(name)
             if entry is None:
-                from metaopt_tpu.algo.base import make_algorithm
+                from metaopt_tpu.algo.base import BaseAlgorithm, make_algorithm
                 from metaopt_tpu.ledger.experiment import Experiment
                 from metaopt_tpu.worker.producer import Producer
 
-                ledger = _LockedLedger(self.inner, self._lock)
-                if ledger.load_experiment(name) is None:
+                if self.ledger.load_experiment(name) is None:
                     raise KeyError(f"experiment {name!r} not found")
-                exp = Experiment(name, ledger=ledger).configure()
+                exp = Experiment(name, ledger=self.ledger).configure()
                 algo = make_algorithm(exp.space, exp.algorithm)
-                entry = (Producer(exp, algo), threading.Lock())
+                producer = Producer(exp, algo)
+                # algorithms that never suspend (the base no-op) let the
+                # suspend verdict skip the producer lock entirely — asking
+                # a trivial question must not queue behind a running fit
+                producer.suspend_is_noop = (
+                    type(algo).should_suspend is BaseAlgorithm.should_suspend
+                )
+                # passive = nothing consults the fit BETWEEN produce
+                # cycles (no judge, no suspension verdicts), so observe
+                # timing is unobservable and workers may skip provably
+                # no-op produce legs (see worker_cycle's ``algo_passive``)
+                producer.algo_passive = (
+                    producer.suspend_is_noop
+                    and type(algo).judge is BaseAlgorithm.judge
+                )
+                entry = (producer, threading.Lock())
                 self._producers[name] = entry
 
                 def on_cycle(batch, _name=name):
@@ -469,26 +650,178 @@ class CoordServer:
             coalescer = self._coalescers[name]
         return entry[0], entry[1], coalescer
 
-    def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
-        """Reply-cache lookup + dispatch + store under ONE lock hold.
+    def _worker_cycle(self, a: Dict[str, Any]) -> Dict[str, Any]:
+        """One fused worker trial cycle: push → sweep → produce → reserve
+        → counts.
 
-        Atomicity matters: a retry arriving while the original request is
-        still executing must block on the lock and then hit the cache —
-        otherwise "reply lost mid-dispatch" double-executes reserve.
+        Collapses the ~5 RPCs a coord-mode workon cycle used to cost
+        (update_trial, release_stale, produce, reserve, count, is_done's
+        doc+count reads) into one round-trip. Semantics mirror the serial
+        sequence exactly:
+
+        - the previous trial's result push rides in first (``complete``:
+          the worker defers its terminal ``update_trial`` to the next
+          cycle, halving the steady-state round-trips to ~1 per trial);
+          exactly-once comes from the worker_cycle reply cache, which
+          already guards the embedded reserve,
+        - the stale sweep runs next (only when the caller's throttle asks
+          for it, via ``stale_timeout_s``),
+        - ``Experiment.is_done`` is evaluated server-side BEFORE the
+          produce/reserve legs, mirroring the serial loop's
+          ``is_done → produce → reserve`` order: a cycle whose own
+          completion leg just finished the experiment returns
+          ``trial=None`` instead of reserving work the serial loop would
+          never have reserved,
+        - the produce leg funnels through the SAME per-experiment
+          coalescer as the ``produce`` op, so fused and serial clients
+          group-commit together and the registered suggestion stream is
+          bit-identical to serial serving,
+        - the reserved trial (if any) ships with its pending control
+          signal and the hosted algorithm's ``should_suspend`` verdict, so
+          the worker needs no follow-up RPC before executing,
+        - ``counts`` + the experiment doc's budget/algo_done let the worker
+          evaluate ``Experiment.is_done`` locally next cycle.
+        """
+        name = a["experiment"]
+        worker = a.get("worker") or "worker"
+        out: Dict[str, Any] = {
+            "released": 0, "registered": 0, "algo_done": False,
+            "coalesced": 0, "trial": None, "signal": None, "suspend": False,
+            "completed_ok": None,
+        }
+        entry = self._producers.get(name)
+        if entry is not None:
+            # tells the worker it may skip provably no-op produce legs:
+            # nothing consults this algorithm's fit between produce cycles
+            out["algo_passive"] = getattr(entry[0], "algo_passive", False)
+        complete = a.get("complete")
+        if complete:
+            t = Trial.from_dict(complete["trial"])
+            out["completed_ok"] = bool(self.ledger.update_trial(
+                t,
+                expected_status=complete.get("expected_status", "reserved"),
+                expected_worker=complete.get("expected_worker"),
+            ))
+            if out["completed_ok"]:
+                self._event("update_trial", name, trial=t.id,
+                            status=t.status)
+        timeout_s = a.get("stale_timeout_s")
+        if timeout_s is not None:
+            released = self.ledger.release_stale(name, float(timeout_s))
+            out["released"] = len(released)
+            for t in released:
+                self._event("release_stale", name, trial=t.id)
+        doc = self.ledger.load_experiment(name)
+        if doc is None:
+            raise KeyError(f"experiment {name!r} not found")
+        out["max_trials"] = doc.get("max_trials")
+        out["exp_algo_done"] = bool(doc.get("algo_done"))
+        max_trials = doc.get("max_trials")
+        done = (max_trials is not None
+                and self.ledger.count(name, "completed") >= max_trials)
+        if not done and out["exp_algo_done"]:
+            done = self.ledger.count(name, ("new", "reserved")) == 0
+        if not done:
+            producer = plock = None
+            if a.get("produce", True):
+                producer, plock, coalescer = self._hosted_producer(name)
+                pres = coalescer.produce(a.get("pool_size"), worker=worker)
+                out["registered"] = pres["registered"]
+                out["algo_done"] = pres["algo_done"]
+                out["coalesced"] = pres["coalesced"]
+                # produce may have just exhausted the algorithm; the doc
+                # write (mark_algo_done) happened inside the cycle, so
+                # surface it without a second doc load
+                out["exp_algo_done"] = out["exp_algo_done"] or pres["algo_done"]
+                out["algo_passive"] = getattr(producer, "algo_passive", False)
+            t = self.ledger.reserve(name, worker)
+            if t is not None:
+                self._event("reserve", name, trial=t.id, worker=worker)
+                out["trial"] = t.to_dict()
+                with self._sig_lock:
+                    out["signal"] = self._signals.get((name, t.id))
+                if producer is None and self.host_algorithms:
+                    # produce was skipped this cycle, but the suspension
+                    # verdict is still owed for every reservation
+                    producer, plock, _ = self._hosted_producer(name)
+                if producer is not None and not getattr(
+                        producer, "suspend_is_noop", False):
+                    with plock:
+                        out["suspend"] = bool(
+                            producer.algorithm.should_suspend(t))
+        out["counts"] = {
+            s: self.ledger.count(name, s)
+            for s in ("new", "reserved", "completed")
+        }
+        return out
+
+    def _handle_worker_cycle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """worker_cycle dispatch with exactly-once retry semantics.
+
+        The cycle embeds a reserve, so a retry whose original reply was
+        lost must be answered from the reply cache — and a retry racing
+        the still-running original must WAIT for that reply rather than
+        re-execute (the in-flight event mirrors what holding the dispatch
+        lock achieves for single-op mutations)."""
+        req = msg.get("req")
+        if req:
+            with self._replies_lock:
+                cached = self._replies.get(req)
+            if cached is not None:
+                return cached
+            with self._inflight_lock:
+                ev = self._inflight.get(req)
+                owner = ev is None
+                if owner:
+                    ev = self._inflight[req] = threading.Event()
+            if not owner:
+                ev.wait(timeout=600.0)
+                with self._replies_lock:
+                    cached = self._replies.get(req)
+                if cached is not None:
+                    return cached
+                return {"ok": False, "error": "CoordRPCError",
+                        "msg": "worker_cycle retry raced an unfinished "
+                               "original past the wait budget"}
+        try:
+            self._ops = next(self._op_counter)
+            result = self._worker_cycle(msg.get("args") or {})
+            reply: Dict[str, Any] = {"ok": True, "result": result}
+        except Exception as e:
+            reply = {"ok": False, "error": type(e).__name__, "msg": str(e)}
+        if req:
+            with self._replies_lock:
+                self._replies[req] = reply
+                while len(self._replies) > self._replies_cap:
+                    self._replies.popitem(last=False)
+            with self._inflight_lock:
+                ev = self._inflight.pop(req, None)
+            if ev is not None:
+                ev.set()
+        return reply
+
+    def _handle(self, msg: Dict[str, Any]) -> Union[Dict[str, Any], bytes]:
+        """Dispatch one request; returns a reply dict or preencoded bytes.
+
+        Mutating ops hold their EXPERIMENT's lock across reply-cache
+        lookup + dispatch + store — a retry arriving while the original
+        request is still executing blocks on that lock and then hits the
+        cache, so "reply lost mid-dispatch" cannot double-execute reserve.
         (Scope: connection drops. A coordinator *restart* clears the cache;
         orphaned reservations from that path are reclaimed by the stale
-        sweep.)
+        sweep.) Read ops take no server lock at all.
         """
         op = msg.get("op")
         if op in ("produce", "judge", "should_suspend"):
-            # dispatched OUTSIDE _lock: an algorithm fit (TPE at 10k
-            # observations takes seconds) must not stall heartbeats — a
-            # blocked heartbeat path lets the stale sweep reclaim LIVE
-            # reservations. The per-experiment lock serializes the shared
-            # algorithm; its ledger ops re-enter _lock one at a time via
-            # _LockedLedger. Not reply-cached: a retried produce just
-            # registers extra suggestions, absorbed by the budget check +
-            # ledger dedup exactly like decentralized producer races.
+            # dispatched outside every ledger lock: an algorithm fit (TPE
+            # at 10k observations takes seconds) must not stall heartbeats
+            # — a blocked heartbeat path lets the stale sweep reclaim LIVE
+            # reservations. The per-experiment producer lock serializes
+            # the shared algorithm; its ledger ops re-enter the
+            # experiment's ledger lock one at a time via _ShardedLedger.
+            # Not reply-cached: a retried produce just registers extra
+            # suggestions, absorbed by the budget check + ledger dedup
+            # exactly like decentralized producer races.
             try:
                 a = msg.get("args") or {}
                 producer, plock, coalescer = self._hosted_producer(
@@ -503,6 +836,10 @@ class CoordServer:
                         result = producer.algorithm.judge(
                             Trial.from_dict(a["trial"]), a["partial"]
                         )
+                elif getattr(producer, "suspend_is_noop", False):
+                    # base no-op verdict: answer without queueing behind a
+                    # running fit on the producer lock
+                    result = False
                 else:
                     with plock:
                         result = bool(producer.algorithm.should_suspend(
@@ -511,10 +848,13 @@ class CoordServer:
                 return {"ok": True, "result": result}
             except Exception as e:
                 return {"ok": False, "error": type(e).__name__, "msg": str(e)}
+        if op == "worker_cycle":
+            return self._handle_worker_cycle(msg)
         if op == "snapshot":
-            # dispatched OUTSIDE _lock: snapshot() takes _snap_lock → _lock
-            # itself, and taking _lock first here would deadlock AB-BA
-            # against the housekeeping/stop() snapshot path
+            # dispatched outside the ledger locks: snapshot() takes
+            # _snap_lock then each experiment's lock in turn, and holding
+            # one here first would AB-BA against the housekeeping/stop()
+            # snapshot path
             try:
                 a = msg.get("args") or {}
                 path = a.get("path") or self.snapshot_path
@@ -524,133 +864,177 @@ class CoordServer:
                 return {"ok": True, "result": path}
             except Exception as e:
                 return {"ok": False, "error": type(e).__name__, "msg": str(e)}
-        req = msg.get("req") if op in self._MUTATING_OPS else None
-        with self._lock:
-            if req is not None:
-                cached = self._replies.get(req)
-                if cached is not None:
-                    return cached
+        a = msg.get("args") or {}
+        if op in self._CACHED_READS:
+            # preserialized-reply fast path: the counter is read BEFORE the
+            # fetch executes, so an entry can only ever be stamped older
+            # than the data it holds — a racing commit makes the entry
+            # miss, never serves stale bytes
+            exp = a.get("experiment")
+            mut = self._mut.get(exp, 0)
+            key = (op, exp, json.dumps(a, sort_keys=True, default=str))
+            with self._enc_lock:
+                ent = self._enc_cache.get(key)
+                if ent is not None and ent[0] == mut:
+                    self._enc_cache.move_to_end(key)
+                    self._enc_hits += 1
+                    return ent[1]
             try:
-                result = self._dispatch(op, msg.get("args") or {})
-                reply = {"ok": True, "result": result}
-            except Exception as e:  # marshal, don't crash the service
-                reply = {"ok": False, "error": type(e).__name__, "msg": str(e)}
-            if req is not None:
-                self._replies[req] = reply
-                while len(self._replies) > self._replies_cap:
-                    self._replies.popitem(last=False)
-        if op == "delete_experiment" and reply.get("ok") and reply.get("result"):
-            # the hosted algorithm dies with the experiment — popped here,
-            # outside _lock, because _hosted_producer nests the two locks
-            # in the opposite order (_producers_guard → _lock)
-            with self._producers_guard:
-                self._producers.pop((msg.get("args") or {}).get("name"), None)
-                self._coalescers.pop((msg.get("args") or {}).get("name"), None)
-            # durability: restore() merges a stale snapshot's docs back in,
-            # which would RESURRECT the deleted experiment after a crash —
-            # so persist the post-delete state now. Outside _lock: snapshot
-            # takes _snap_lock → _lock (AB-BA with housekeeping otherwise).
-            if self.snapshot_path:
+                payload = encode_msg(
+                    {"ok": True, "result": self._dispatch(op, a)})
+            except Exception as e:  # errors are not worth caching
+                return {"ok": False, "error": type(e).__name__, "msg": str(e)}
+            with self._enc_lock:
+                self._enc_cache[key] = (mut, payload)
+                self._enc_cache.move_to_end(key)
+                while len(self._enc_cache) > self._enc_cap:
+                    self._enc_cache.popitem(last=False)
+            return payload
+        if op in self._MUTATING_OPS:
+            req = msg.get("req")
+            with self._op_lock(op, a):
+                if req is not None:
+                    with self._replies_lock:
+                        cached = self._replies.get(req)
+                    if cached is not None:
+                        return cached
                 try:
-                    self.snapshot(self.snapshot_path)
-                except Exception:
-                    log.exception("post-delete snapshot failed")
-        return reply
+                    reply = {"ok": True, "result": self._dispatch(op, a)}
+                except Exception as e:  # marshal, don't crash the service
+                    reply = {"ok": False, "error": type(e).__name__,
+                             "msg": str(e)}
+                if req is not None:
+                    with self._replies_lock:
+                        self._replies[req] = reply
+                        while len(self._replies) > self._replies_cap:
+                            self._replies.popitem(last=False)
+            if (op == "delete_experiment" and reply.get("ok")
+                    and reply.get("result")):
+                # the hosted algorithm dies with the experiment — popped
+                # here, outside the ledger locks, because _hosted_producer
+                # nests the two guards in the opposite order
+                # (_producers_guard → experiment lock)
+                with self._producers_guard:
+                    self._producers.pop(a.get("name"), None)
+                    self._coalescers.pop(a.get("name"), None)
+                # durability: restore() merges a stale snapshot's docs back
+                # in, which would RESURRECT the deleted experiment after a
+                # crash — so persist the post-delete state now. Outside the
+                # ledger locks: snapshot takes _snap_lock → exp locks
+                # (AB-BA with housekeeping otherwise).
+                if self.snapshot_path:
+                    try:
+                        self.snapshot(self.snapshot_path)
+                    except Exception:
+                        log.exception("post-delete snapshot failed")
+            return reply
+        # plain reads (get/count/load/list/heartbeat/ping): no server lock,
+        # no caches — the backend's own locking is the only serialization
+        try:
+            return {"ok": True, "result": self._dispatch(op, a)}
+        except Exception as e:
+            return {"ok": False, "error": type(e).__name__, "msg": str(e)}
 
     def _dispatch(self, op: Optional[str], a: Dict[str, Any]) -> Any:
-        with self._lock:
-            self._ops += 1
-            if op == "ping":
-                return {"pong": True, "ops": self._ops}
-            if op == "create_experiment":
-                self.inner.create_experiment(a["config"])
-                self._event("create_experiment", a["config"].get("name"))
-                return None
-            if op == "load_experiment":
-                return self.inner.load_experiment(a["name"])
-            if op == "update_experiment":
-                self.inner.update_experiment(a["name"], a["patch"])
-                return None
-            if op == "list_experiments":
-                return self.inner.list_experiments()
-            if op == "delete_experiment":
-                name = a["name"]
-                ok = bool(self.inner.delete_experiment(name))
-                if ok:
-                    # pending signals die with the docs. The hosted
-                    # producer is popped later, OUTSIDE _lock (the
-                    # post-reply hook in _handle): taking _producers_guard
-                    # here would AB-BA against _hosted_producer, which
-                    # holds _producers_guard while its ledger ops take
-                    # _lock
+        self._ops = next(self._op_counter)
+        if op == "ping":
+            return {"pong": True, "ops": self._ops, "caps": list(CAPS)}
+        if op == "create_experiment":
+            self.ledger.create_experiment(a["config"])
+            self._event("create_experiment", a["config"].get("name"))
+            return None
+        if op == "load_experiment":
+            return self.ledger.load_experiment(a["name"])
+        if op == "update_experiment":
+            self.ledger.update_experiment(a["name"], a["patch"])
+            return None
+        if op == "list_experiments":
+            return self.ledger.list_experiments()
+        if op == "delete_experiment":
+            name = a["name"]
+            ok = bool(self.ledger.delete_experiment(name))
+            if ok:
+                # pending signals die with the docs. The hosted producer
+                # is popped later, OUTSIDE the ledger locks (the
+                # post-reply hook in _handle): taking _producers_guard
+                # here would AB-BA against _hosted_producer, which holds
+                # _producers_guard while its ledger ops take exp locks
+                with self._sig_lock:
                     self._signals = {
-                        k: v for k, v in self._signals.items() if k[0] != name
+                        k: v for k, v in self._signals.items()
+                        if k[0] != name
                     }
-                    self._event("delete_experiment", name)
-                return ok
-            if op == "register":
-                trial = Trial.from_dict(a["trial"])
-                self.inner.register(trial)
-                self._event("register", trial.experiment, trial=trial.id)
-                return None
-            if op == "reserve":
-                t = self.inner.reserve(a["experiment"], a["worker"])
-                if t is not None:
-                    self._event(
-                        "reserve", a["experiment"], trial=t.id, worker=a["worker"]
-                    )
-                return t.to_dict() if t else None
-            if op == "update_trial":
-                trial = Trial.from_dict(a["trial"])
-                ok = self.inner.update_trial(
-                    trial,
-                    expected_status=a.get("expected_status"),
-                    expected_worker=a.get("expected_worker"),
-                )
-                if ok:
-                    self._event(
-                        "update_trial", trial.experiment,
-                        trial=trial.id, status=trial.status,
-                    )
-                    if trial.status in ("completed", "broken", "interrupted"):
-                        self._signals.pop((trial.experiment, trial.id), None)
-                return ok
-            if op == "heartbeat":
-                ours = self.inner.heartbeat(
-                    a["experiment"], a["trial_id"], a["worker"]
-                )
-                signal = self._signals.get((a["experiment"], a["trial_id"]))
-                return {"ours": ours, "signal": signal}
-            if op == "get":
-                t = self.inner.get(a["experiment"], a["trial_id"])
-                return t.to_dict() if t else None
-            if op == "fetch":
-                status = a.get("status")
-                if isinstance(status, list):
-                    status = tuple(status)
-                return [t.to_dict() for t in self.inner.fetch(a["experiment"], status)]
-            if op == "count":
-                status = a.get("status")
-                if isinstance(status, list):
-                    status = tuple(status)
-                return self.inner.count(a["experiment"], status)
-            if op == "fetch_completed_since":
-                trials, cur = self.inner.fetch_completed_since(
-                    a["experiment"], a.get("cursor")
-                )
-                return {"trials": [t.to_dict() for t in trials],
-                        "cursor": cur}
-            if op == "release_stale":
-                released = self.inner.release_stale(a["experiment"], a["timeout_s"])
-                return [t.to_dict() for t in released]
-            if op == "set_signal":
-                self._signals[(a["experiment"], a["trial_id"])] = a["signal"]
+                self._event("delete_experiment", name)
+            return ok
+        if op == "register":
+            trial = Trial.from_dict(a["trial"])
+            self.ledger.register(trial)
+            self._event("register", trial.experiment, trial=trial.id)
+            return None
+        if op == "reserve":
+            t = self.ledger.reserve(a["experiment"], a["worker"])
+            if t is not None:
                 self._event(
-                    "set_signal", a["experiment"],
-                    trial=a["trial_id"], signal=a["signal"],
+                    "reserve", a["experiment"], trial=t.id, worker=a["worker"]
                 )
-                return None
-            raise ValueError(f"unknown op: {op!r}")  # (snapshot: see _handle)
+            return t.to_dict() if t else None
+        if op == "update_trial":
+            trial = Trial.from_dict(a["trial"])
+            ok = self.ledger.update_trial(
+                trial,
+                expected_status=a.get("expected_status"),
+                expected_worker=a.get("expected_worker"),
+            )
+            if ok:
+                self._event(
+                    "update_trial", trial.experiment,
+                    trial=trial.id, status=trial.status,
+                )
+                if trial.status in ("completed", "broken", "interrupted"):
+                    with self._sig_lock:
+                        self._signals.pop(
+                            (trial.experiment, trial.id), None)
+            return ok
+        if op == "heartbeat":
+            ours = self.ledger.heartbeat(
+                a["experiment"], a["trial_id"], a["worker"]
+            )
+            with self._sig_lock:
+                signal = self._signals.get((a["experiment"], a["trial_id"]))
+            return {"ours": ours, "signal": signal}
+        if op == "get":
+            t = self.ledger.get(a["experiment"], a["trial_id"])
+            return t.to_dict() if t else None
+        if op == "fetch":
+            status = a.get("status")
+            if isinstance(status, list):
+                status = tuple(status)
+            return [t.to_dict()
+                    for t in self.ledger.fetch(a["experiment"], status)]
+        if op == "count":
+            status = a.get("status")
+            if isinstance(status, list):
+                status = tuple(status)
+            return self.ledger.count(a["experiment"], status)
+        if op == "fetch_completed_since":
+            trials, cur = self.ledger.fetch_completed_since(
+                a["experiment"], a.get("cursor")
+            )
+            return {"trials": [t.to_dict() for t in trials],
+                    "cursor": cur}
+        if op == "release_stale":
+            released = self.ledger.release_stale(a["experiment"],
+                                                 a["timeout_s"])
+            return [t.to_dict() for t in released]
+        if op == "set_signal":
+            with self._sig_lock:
+                self._signals[(a["experiment"], a["trial_id"])] = a["signal"]
+            self._event(
+                "set_signal", a["experiment"],
+                trial=a["trial_id"], signal=a["signal"],
+            )
+            return None
+        raise ValueError(f"unknown op: {op!r}")  # (snapshot: see _handle)
 
 
 def serve_forever(server: CoordServer) -> None:

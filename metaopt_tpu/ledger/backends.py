@@ -262,7 +262,7 @@ class MemoryLedger(LedgerBackend):
             exp = self._trials.setdefault(trial.experiment, {})
             if trial.id in exp:
                 raise DuplicateTrialError(trial.id)
-            exp[trial.id] = Trial.from_dict(trial.to_dict())
+            exp[trial.id] = trial.clone()
             self._move(trial.experiment, trial.id, None, trial.status)
             if trial.status == "completed":  # db load of finished trials
                 self._completed_log.setdefault(
@@ -286,7 +286,7 @@ class MemoryLedger(LedgerBackend):
                     t.transition("reserved")
                     t.worker = worker
                     self._move(experiment, tid, "new", "reserved")
-                    return Trial.from_dict(t.to_dict())
+                    return t.clone()
         return None
 
     def update_trial(
@@ -308,7 +308,7 @@ class MemoryLedger(LedgerBackend):
                 self._completed_log.setdefault(
                     trial.experiment, []
                 ).append(trial.id)
-            exp[trial.id] = Trial.from_dict(trial.to_dict())
+            exp[trial.id] = trial.clone()
             self._move(trial.experiment, trial.id, stored.status, trial.status)
             return True
 
@@ -323,7 +323,7 @@ class MemoryLedger(LedgerBackend):
     def get(self, experiment: str, trial_id: str) -> Optional[Trial]:
         with self._lock:
             t = self._trials.get(experiment, {}).get(trial_id)
-            return Trial.from_dict(t.to_dict()) if t else None
+            return t.clone() if t else None
 
     def fetch(self, experiment: str, status=None) -> List[Trial]:
         statuses = (status,) if isinstance(status, str) else status
@@ -336,7 +336,7 @@ class MemoryLedger(LedgerBackend):
                 ids = set().union(*(idx.get(s, set()) for s in statuses)) \
                     if statuses else set()
                 picked = (exp[i] for i in ids if i in exp)
-            out = [Trial.from_dict(t.to_dict()) for t in picked]
+            out = [t.clone() for t in picked]
             out.sort(key=lambda t: (t.submit_time or 0, t.id))
             return out
 
@@ -372,7 +372,7 @@ class MemoryLedger(LedgerBackend):
                 start = int(cursor[2])
             exp = self._trials.get(experiment, {})
             out = [
-                Trial.from_dict(exp[tid].to_dict())
+                exp[tid].clone()
                 for tid in log_[start:]
                 # a revived (completed→new) trial stays in the log; skip
                 # it until it re-completes and re-appends
@@ -585,9 +585,13 @@ class FileLedger(LedgerBackend):
                     done.append((doc.get("end_time") or 0, doc["id"]))
                 elif doc.get("status") == "new":
                     fresh.append([doc.get("submit_time") or 0, doc["id"]])
+        counts: Dict[str, int] = {}
+        for s in statuses.values():
+            counts[s] = counts.get(s, 0) + 1
         idx = {
             "epoch": uuid.uuid4().hex,
             "statuses": statuses,
+            "counts": counts,
             "completed_log": [tid for _, tid in sorted(done)],
             "new_queue": sorted(fresh),
         }
@@ -597,6 +601,41 @@ class FileLedger(LedgerBackend):
         except OSError:
             pass
         return idx
+
+    @staticmethod
+    def _idx_counts(idx: Dict[str, Any]) -> Dict[str, int]:
+        """The index's per-status counts, derived once for a legacy
+        snapshot that predates the ``counts`` key and maintained
+        incrementally afterwards (see :meth:`_idx_status_set`) — this is
+        what makes :meth:`count` O(1) instead of a scan over every
+        trial's status each workon-cycle poll."""
+        counts = idx.get("counts")
+        if counts is None:
+            counts = {}
+            for s in idx["statuses"].values():
+                counts[s] = counts.get(s, 0) + 1
+            idx["counts"] = counts
+        return counts
+
+    @classmethod
+    def _idx_status_set(cls, idx: Dict[str, Any], trial_id: str,
+                        status: str) -> Optional[str]:
+        """Single write point for ``idx["statuses"]`` so the incremental
+        counts can never drift from the statuses map; returns the prior
+        status."""
+        counts = cls._idx_counts(idx)
+        old = idx["statuses"].get(trial_id)
+        if old == status:
+            return old
+        if old is not None:
+            left = counts.get(old, 0) - 1
+            if left > 0:
+                counts[old] = left
+            else:
+                counts.pop(old, None)
+        counts[status] = counts.get(status, 0) + 1
+        idx["statuses"][trial_id] = status
+        return old
 
     def _index_stamp(self, experiment: str):
         """(snapshot mtime+size, log size) — the cache key."""
@@ -635,7 +674,7 @@ class FileLedger(LedgerBackend):
             tid, status = rec.get("t"), rec.get("s")
             if not tid or not status:
                 continue
-            idx["statuses"][tid] = status
+            self._idx_status_set(idx, tid, status)
             if status == "completed" and tid not in done:
                 idx["completed_log"].append(tid)
                 done.add(tid)
@@ -715,8 +754,7 @@ class FileLedger(LedgerBackend):
         import bisect
 
         idx = self._load_index(experiment, heal=False)
-        old = idx["statuses"].get(trial_id)
-        idx["statuses"][trial_id] = status
+        old = self._idx_status_set(idx, trial_id, status)
         if status == "completed" and old != "completed":
             idx["completed_log"].append(trial_id)
         elif status == "new":
@@ -809,7 +847,8 @@ class FileLedger(LedgerBackend):
                     queue.pop(0)
                     # doc drifted from index (old-version writer): heal
                     if doc is not None:
-                        idx["statuses"][tid] = doc.get("status", "new")
+                        self._idx_status_set(
+                            idx, tid, doc.get("status", "new"))
                     continue
                 t = Trial.from_dict(doc)
                 t.transition("reserved")
@@ -845,14 +884,18 @@ class FileLedger(LedgerBackend):
             return True
 
     def count(self, experiment: str, status=None) -> int:
+        # O(1) off the index's incremental per-status counts (the workon
+        # loop polls count() every cycle; scanning every trial's status
+        # made that O(n²) over an experiment's life)
         statuses = (status,) if isinstance(status, str) else status
         with self._locked(experiment):
             if not os.path.isdir(self._edir(experiment)):
                 return 0
-            vals = self._load_index(experiment)["statuses"].values()
+            idx = self._load_index(experiment)
             if statuses is None:
-                return len(vals)
-            return sum(1 for v in vals if v in statuses)
+                return len(idx["statuses"])
+            counts = self._idx_counts(idx)
+            return sum(counts.get(s, 0) for s in statuses)
 
     def fetch_completed_since(self, experiment: str, cursor=None):
         with self._locked(experiment):

@@ -104,10 +104,18 @@ class Experiment:
     # -- trial operations -------------------------------------------------
     def make_trial(self, params: Dict[str, Any], parent: Optional[str] = None) -> Trial:
         assert self.space is not None
-        t = Trial(params=dict(params), experiment=self.name, parent=parent)
-        t.id = self.space.hash_point(params, with_fidelity=True)
-        t.lineage = self.space.hash_point(params)
-        return t
+        # hash before constructing: an id-less Trial would compute (and
+        # immediately discard) its own params hash in __post_init__. With
+        # no fidelity axis the id and lineage hashes are the same value.
+        tid = self.space.hash_point(params, with_fidelity=True)
+        lineage = (
+            tid if self.space.fidelity is None
+            else self.space.hash_point(params)
+        )
+        return Trial(
+            params=dict(params), experiment=self.name, parent=parent,
+            id=tid, lineage=lineage,
+        )
 
     def register_trials(self, trials: List[Trial]) -> List[Trial]:
         """Register suggestions; duplicates (lost suggestion races) dropped."""

@@ -52,6 +52,19 @@ class InvalidTrialTransition(RuntimeError):
     pass
 
 
+def _copy_json_tree(value: Any) -> Any:
+    """Deep-copy nested list/dict structure; scalars pass through.
+
+    Trial fields are JSON-native after ``__post_init__`` (see ``jsonable``),
+    so this is the full deep copy ``from_dict(to_dict())`` used to provide.
+    """
+    if isinstance(value, list):
+        return [_copy_json_tree(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _copy_json_tree(v) for k, v in value.items()}
+    return value
+
+
 @dataclass
 class Trial:
     """One evaluation of a point in the search space."""
@@ -183,6 +196,23 @@ class Trial:
     @classmethod
     def from_dict(cls, doc: Mapping[str, Any]) -> "Trial":
         return cls(**{k: v for k, v in doc.items()})
+
+    def clone(self) -> "Trial":
+        """Deep copy, equivalent to ``from_dict(to_dict())`` minus the dict
+        round-trip. The in-memory ledger snapshots through this on every
+        register/reserve/fetch, so it skips re-validation (__post_init__)
+        of values that already passed it at construction.
+        """
+        t = object.__new__(Trial)
+        d = t.__dict__
+        d.update(self.__dict__)
+        d["params"] = _copy_json_tree(self.params)
+        d["results"] = [
+            Result(r.name, r.type, _copy_json_tree(r.value))
+            for r in self.results
+        ]
+        d["resources"] = _copy_json_tree(self.resources)
+        return t
 
     def __repr__(self) -> str:
         obj = self.objective

@@ -330,7 +330,9 @@ def make_model(hparams: Optional[Dict[str, Any]] = None, **overrides) -> Transfo
 _BLOCKED_XENT_MIN_LOGITS_BYTES = 4 << 30
 
 
-def blocked_xent_enabled(batch: int, seq: int, vocab: int) -> bool:
+def blocked_xent_enabled(
+    batch: int, seq: int, vocab: int, shards: Optional[int] = None,
+) -> bool:
     """True when :func:`loss_fn` folds the readout into the blocked xent.
 
     Gates on the PER-DEVICE materialized f32 logits size: on a parallel
@@ -338,14 +340,24 @@ def blocked_xent_enabled(batch: int, seq: int, vocab: int) -> bool:
     ``global_bytes / batch_shards``, not the global tensor. bench.py labels
     its records with this same predicate — keep them in sync by calling it,
     not copying it.
-    """
-    from metaopt_tpu.parallel.mesh import active_mesh
 
-    shards = 1
-    mesh = active_mesh()
-    if mesh is not None:
-        shape = dict(mesh.shape)
-        shards = shape.get("dp", 1) * shape.get("sp", 1)
+    Routing: ``shards`` is the number of ways the (B, T) batch dims are
+    split. With the default ``shards=None`` the predicate reads the
+    ambient mesh (``active_mesh()``): inside a ``with mesh:`` scope it
+    divides by ``dp * sp``; outside any mesh it treats the tensor as
+    unsharded. Callers deciding routing FOR a mesh they have not entered
+    yet (launchers, planners, bench labeling a future run) pass the shard
+    count explicitly — the ambient lookup would silently read whatever
+    mesh the caller happens to be inside, or none.
+    """
+    if shards is None:
+        from metaopt_tpu.parallel.mesh import active_mesh
+
+        shards = 1
+        mesh = active_mesh()
+        if mesh is not None:
+            shape = dict(mesh.shape)
+            shards = shape.get("dp", 1) * shape.get("sp", 1)
     per_device = 4 * batch * seq * vocab // max(shards, 1)
     return per_device >= _BLOCKED_XENT_MIN_LOGITS_BYTES
 

@@ -15,12 +15,17 @@ import numpy as np
 from metaopt_tpu.space.dimensions import Dimension, Fidelity
 from metaopt_tpu.utils.hashing import point_hash
 
+# fidelity-cache sentinel (None is a valid cached value); a str is
+# deepcopy-atomic, so a copied Space still compares ``is _UNSET`` correctly
+_UNSET = "__fidelity_unset__"
+
 
 class Space:
     """Ordered collection of :class:`Dimension`, keyed by name."""
 
     def __init__(self, dimensions: Optional[Mapping[str, Dimension] | List[Dimension]] = None):
         self._dims: Dict[str, Dimension] = {}
+        self._fidelity_cache: Any = _UNSET
         if dimensions:
             items = (
                 dimensions.values() if isinstance(dimensions, Mapping) else dimensions
@@ -33,6 +38,7 @@ class Space:
         if dim.name in self._dims:
             raise ValueError(f"dimension {dim.name!r} already in space")
         self._dims[dim.name] = dim
+        self._fidelity_cache = _UNSET
 
     def __getitem__(self, name: str) -> Dimension:
         return self._dims[name]
@@ -55,11 +61,19 @@ class Space:
     # -- fidelity ---------------------------------------------------------
     @property
     def fidelity(self) -> Optional[Fidelity]:
-        """The (single) fidelity dimension, if any."""
-        fids = [d for d in self._dims.values() if isinstance(d, Fidelity)]
-        if len(fids) > 1:
-            raise ValueError(f"multiple fidelity dimensions: {[f.name for f in fids]}")
-        return fids[0] if fids else None
+        """The (single) fidelity dimension, if any.
+
+        Cached (``register`` invalidates): ``hash_point`` reads this on
+        every trial-identity hash, which is per-registration hot.
+        """
+        if self._fidelity_cache is _UNSET:
+            fids = [d for d in self._dims.values() if isinstance(d, Fidelity)]
+            if len(fids) > 1:
+                raise ValueError(
+                    f"multiple fidelity dimensions: {[f.name for f in fids]}"
+                )
+            self._fidelity_cache = fids[0] if fids else None
+        return self._fidelity_cache
 
     @property
     def searchable(self) -> List[Dimension]:

@@ -202,7 +202,8 @@ def run_many_with_deadline(
             j.out_f.close()
 
 
-def setup_xla_cache(env: Optional[dict] = None) -> dict:
+def setup_xla_cache(env: Optional[dict] = None,
+                    min_compile_time_secs: str = "1") -> dict:
     """Point jax's persistent compile cache at ``<repo>/.cache/xla``.
 
     Remote compiles through the relay tunnel run minutes each; the
@@ -211,6 +212,13 @@ def setup_xla_cache(env: Optional[dict] = None) -> dict:
     dryruns skip the dominant compile cost. Mutates and returns ``env``
     (default ``os.environ``) — call BEFORE the target process imports jax,
     since jax binds these variables at import.
+
+    ``min_compile_time_secs`` is jax's threshold below which a compile is
+    not persisted. The default is back at jax's own "1": caching every
+    sub-second CPU compile bloats the cache directory with thousands of
+    tiny entries for no resume win (the relay compiles that matter run
+    minutes). Sweeps that DO want the trivial-compile reuse (e.g. repeat
+    CPU dryruns of one program) can pass "0" explicitly.
 
     The XLA:CPU AOT sub-cache is forced OFF: it serializes host machine
     features and reloads them elsewhere with pages of mismatch errors and
@@ -223,7 +231,8 @@ def setup_xla_cache(env: Optional[dict] = None) -> dict:
     cache = os.path.join(repo, ".cache", "xla")
     os.makedirs(cache, exist_ok=True)
     target.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
-    target.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    target.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                      min_compile_time_secs)
     target["JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES"] = "none"
     return target
 

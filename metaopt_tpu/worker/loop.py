@@ -97,15 +97,92 @@ def workon(
     last_sweep = 0.0
     last_broken_note = ""
 
-    def heartbeat_for(trial: Trial):
+    # fused coord path: one worker_cycle RPC per loop iteration replaces
+    # the serial release_stale → produce → reserve → count → should_suspend
+    # wire sequence (~5 round-trips → 1). The client degrades to the serial
+    # composition against a pre-worker_cycle coordinator, so this stays the
+    # ONLY coord-mode path either way.
+    fused = producer_mode == "coord" and hasattr(
+        experiment.ledger, "worker_cycle"
+    )
+    #: the latest fused-cycle reply — carries the counts/doneness snapshot
+    #: the next is_done check reads locally instead of re-RPCing
+    last_cycle: Optional[Dict[str, Any]] = None
+    #: fused path: a finished trial whose terminal update rides the NEXT
+    #: worker_cycle instead of costing its own RPC — (trial, was_pruned);
+    #: flushed with a plain update_trial if the loop exits first
+    pending_push: Optional[tuple] = None
+
+    def _resolve_push(ok: bool) -> None:
+        nonlocal pending_push
+        t_done, was_pruned = pending_push  # type: ignore[misc]
+        pending_push = None
+        if ok:
+            stats.completed += 1
+            stats.pruned += was_pruned
+        else:
+            log.warning(
+                "%s lost reservation of %s before result push",
+                worker_id, t_done.id,
+            )
+
+    def _flush_pending() -> None:
+        if pending_push is None:
+            return
+        _resolve_push(experiment.ledger.update_trial(
+            pending_push[0], expected_status="reserved",
+            expected_worker=worker_id,
+        ))
+
+    def heartbeat_for(trial: Trial, primed: bool = False):
+        # ``primed``: the fused reply just showed no pending signal for a
+        # reservation microseconds old, so the executor's FIRST beat (which
+        # it fires immediately on start) is answered locally; every later
+        # beat goes to the wire and catches real signals/lost reservations
+        state = {"primed": primed}
+
         def beat() -> bool:
+            if state["primed"]:
+                state["primed"] = False
+                return True
             return experiment.ledger.heartbeat(experiment.name, trial.id, worker_id)
         return beat
 
     def judge_fn(trial: Trial, partial: List[Dict[str, Any]]):
         return producer.judge(trial, partial)
 
-    while not experiment.is_done:
+    def _cycle_done(r: Dict[str, Any]) -> bool:
+        """``Experiment.is_done`` evaluated from the fused reply's snapshot
+        (doc fields + status counts) instead of 3 fresh RPCs. The snapshot
+        is as fresh as serial re-counting w.r.t. THIS worker — _settle()
+        folds our own transitions in — and one cycle stale w.r.t. other
+        workers, which only costs one extra (budget-guarded) cycle."""
+        if r.get("max_trials") is not None:
+            # keep the live `mtpu db set max_trials=N` override behavior
+            experiment.max_trials = r["max_trials"]
+        c = r["counts"]
+        if c["completed"] >= experiment.max_trials:
+            return True
+        if not r.get("exp_algo_done"):
+            return False
+        return c["new"] + c["reserved"] == 0
+
+    def _settle(to_status: str) -> None:
+        """Fold this worker's own reserved→terminal transition into the
+        cached cycle counts so the next done-check doesn't miss it."""
+        if last_cycle is None:
+            return
+        c = last_cycle["counts"]
+        c["reserved"] = max(0, c["reserved"] - 1)
+        if to_status in c:
+            c[to_status] += 1
+
+    while True:
+        if last_cycle is not None:
+            if _cycle_done(last_cycle):
+                break
+        elif experiment.is_done:
+            break
         if stop_event is not None and stop_event.is_set():
             log.info("%s: stop requested — winding down", worker_id)
             break
@@ -125,18 +202,61 @@ def workon(
         # nothing and costs an RPC/lock round-trip per cycle — on the
         # coord backend that was one of ~5 RPCs per trial
         now = time.time()
-        if now - last_sweep >= stale_sweep_interval_s:
-            experiment.ledger.release_stale(
-                experiment.name, heartbeat_timeout_s
+        sweep = now - last_sweep >= stale_sweep_interval_s
+        if fused:
+            # skip the produce leg when the registration budget is provably
+            # exhausted: completed+new+reserved only grows (requeues move
+            # within the sum), so a one-cycle-stale sum >= max_trials still
+            # proves no suggest can register — the produce would be a pure
+            # no-op observe. Only when the server says the algorithm is
+            # passive (``algo_passive``: no judge/suspend verdicts consult
+            # the fit between produces), so observe timing is unobservable
+            # and the suggestion stream provably identical. Trials leaving
+            # the sum (broken/interrupted) reopen budget; the next reply's
+            # fresh counts catch that one cycle later.
+            produce_cycle = True
+            if (last_cycle is not None
+                    and last_cycle.get("algo_passive")
+                    and experiment.max_trials is not None):
+                c = last_cycle["counts"]
+                produce_cycle = (
+                    c["new"] + c["reserved"] + c["completed"]
+                    < experiment.max_trials
+                )
+            complete = None
+            if pending_push is not None:
+                complete = {
+                    "trial": pending_push[0].to_dict(),
+                    "expected_status": "reserved",
+                    "expected_worker": worker_id,
+                }
+            last_cycle = producer.cycle(
+                stale_timeout_s=heartbeat_timeout_s if sweep else None,
+                produce=produce_cycle,
+                complete=complete,
             )
+            if complete is not None:
+                _resolve_push(bool(last_cycle.get("completed_ok")))
+            produced = last_cycle["registered"]
+            trial = last_cycle["trial"]
+        else:
+            if sweep:
+                experiment.ledger.release_stale(
+                    experiment.name, heartbeat_timeout_s
+                )
+            produced = producer.produce()
+            trial = experiment.reserve_trial(worker_id)
+        if sweep:
             last_sweep = now
-        produced = producer.produce()
-        trial = experiment.reserve_trial(worker_id)
 
         if trial is None:
             # nothing to run: either in-flight trials elsewhere, an algorithm
             # barrier (sync rungs / generation waits), or true exhaustion
-            in_flight = experiment.count("reserved")
+            in_flight = (
+                last_cycle["counts"]["reserved"]
+                if last_cycle is not None
+                else experiment.count("reserved")
+            )
             if produced == 0 and in_flight == 0:
                 stats.idle_cycles += 1
                 if producer.algo_done or stats.idle_cycles > max_idle_cycles:
@@ -149,7 +269,12 @@ def workon(
 
         stats.idle_cycles = 0
         stats.reserved += 1
-        if producer.should_suspend(trial):
+        suspend = (
+            last_cycle["suspend"]  # verdict rode the fused reply
+            if last_cycle is not None
+            else producer.should_suspend(trial)
+        )
+        if suspend:
             # the algorithm wants this trial parked (e.g. a bracket wants
             # its budget elsewhere first): suspended, not executed;
             # ``mtpu resume`` flips suspended trials back to new
@@ -158,12 +283,23 @@ def workon(
                 trial, expected_status="reserved", expected_worker=worker_id
             )
             stats.suspended += 1
+            _settle("suspended")
             continue
         log.debug("%s running trial %s %s", worker_id, trial.id[:8], trial.params)
         t0 = time.time()
         try:
             res = executor.execute(
-                trial, heartbeat=heartbeat_for(trial), judge=judge_fn
+                trial,
+                heartbeat=heartbeat_for(
+                    trial,
+                    # safe to answer the executor's immediate first beat
+                    # locally: the fused reply just told us this fresh
+                    # reservation has no pending signal
+                    primed=(last_cycle is not None
+                            and last_cycle.get("fused", False)
+                            and last_cycle.get("signal") is None),
+                ),
+                judge=judge_fn,
             )
         except KeyboardInterrupt:
             trial.transition("interrupted")
@@ -176,15 +312,28 @@ def workon(
         trial.exit_code = res.exit_code
         requeue_budget_spent = False
         if res.status == "completed":
-            ok = experiment.push_results(trial, res.results)
-            if ok:
-                stats.completed += 1
-                if "pruned" in res.note:
-                    stats.pruned += 1
+            if fused:
+                # defer the terminal update: it rides the next worker_cycle
+                # (the cycle is due immediately anyway), so the steady-state
+                # coord cost is ~1 RPC per trial instead of 2. The server
+                # applies it before its produce/reserve legs — same order
+                # as push-then-cycle — and the reply's counts/doneness
+                # already include it, so no _settle here.
+                trial.attach_results(res.results)
+                trial.transition("completed")
+                pending_push = (trial, int("pruned" in res.note))
             else:
-                log.warning(
-                    "%s lost reservation of %s before result push", worker_id, trial.id
-                )
+                ok = experiment.push_results(trial, res.results)
+                if ok:
+                    stats.completed += 1
+                    _settle("completed")
+                    if "pruned" in res.note:
+                        stats.pruned += 1
+                else:
+                    log.warning(
+                        "%s lost reservation of %s before result push",
+                        worker_id, trial.id,
+                    )
         elif (res.requeue
               and int(trial.resources.get("requeues", 0)) < max_requeues):
             # infrastructure failure (device wedge/park budget): release
@@ -201,6 +350,7 @@ def workon(
             )
             if ok:
                 stats.requeued += 1
+                _settle("new")
                 log.warning(
                     "%s requeued trial %s (%d/%d): %s", worker_id,
                     trial.id[:8], n_req, max_requeues, res.note,
@@ -222,6 +372,7 @@ def workon(
             experiment.ledger.update_trial(
                 trial, expected_status="reserved", expected_worker=worker_id
             )
+            _settle(res.status)
             stats.broken += res.status == "broken"
             stats.interrupted += res.status == "interrupted"
             if res.status == "broken":
@@ -263,6 +414,10 @@ def workon(
             )
             break
 
+    # a result the next cycle never got to carry (the loop exited first)
+    # still must reach the ledger — the deferred push is an optimization,
+    # never a correctness trade
+    _flush_pending()
     # final observe so the algorithm state is current for callers (the
     # coordinator-hosted algorithm observes inside its own produce cycles)
     if algo is not None:

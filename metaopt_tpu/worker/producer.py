@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 from metaopt_tpu.algo.base import BaseAlgorithm
 from metaopt_tpu.ledger.experiment import Experiment
@@ -199,6 +199,46 @@ class RemoteProducer:
             self.timings["coalesced"] += 1
         self.algo_done = bool(out.get("algo_done"))
         return out["registered"]
+
+    def cycle(
+        self,
+        pool_size: Optional[int] = None,
+        stale_timeout_s: Optional[float] = None,
+        produce: bool = True,
+        complete: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """One fused worker cycle (push→sweep→produce→reserve→counts) in a
+        single round-trip — see ``CoordLedgerClient.worker_cycle``. The
+        produce leg rides the server's shared coalescer, so the registered
+        suggestion stream is bit-identical to :meth:`produce` + reserve
+        served serially; against a pre-``worker_cycle`` coordinator the
+        client composes the same reply from the serial RPCs.
+
+        ``produce=False`` skips the produce leg (the workon loop sends it
+        when the registration budget is provably exhausted — a no-op cycle
+        not worth a fit-lock round-trip); ``complete`` carries the
+        previous trial's deferred terminal update."""
+        t0 = time.perf_counter()
+        out = self.experiment.ledger.worker_cycle(
+            self.experiment.name,
+            self.worker or "worker",
+            pool_size=pool_size or self.experiment.pool_size,
+            stale_timeout_s=stale_timeout_s,
+            produce=produce,
+            complete=complete,
+        )
+        self.timings["produce_rpc_s"] += time.perf_counter() - t0
+        self.timings["cycles"] += 1
+        self.timings["suggested"] += out["registered"]
+        if int(out.get("coalesced", 1)) > 1:
+            self.timings["coalesced"] += 1
+        if out.get("fused"):
+            self.timings["fused_cycles"] = (
+                self.timings.get("fused_cycles", 0) + 1
+            )
+        if produce:
+            self.algo_done = bool(out.get("algo_done"))
+        return out
 
     def judge(self, trial, partial):
         return self.experiment.ledger.judge(self.experiment.name, trial, partial)

@@ -134,6 +134,27 @@ class TestTransformer:
         with use_mesh(mesh), pytest.raises(ValueError, match="multiples"):
             model.apply(params, src, src, train=False)
 
+    def test_blocked_xent_routing_explicit_shards_vs_mesh(self):
+        """The xent-routing predicate honors an explicit ``shards`` count
+        and, with the default, reads the ambient mesh — out-of-mesh the
+        tensor is treated as unsharded."""
+        from metaopt_tpu.models.transformer import blocked_xent_enabled
+        from metaopt_tpu.parallel import make_mesh
+        from metaopt_tpu.parallel.mesh import use_mesh
+
+        # global f32 logits = 4*64*512*50000 ≈ 6.55 GB: over the 4 GiB
+        # gate unsharded, under it when split 4 ways over dp
+        batch, seq, vocab = 64, 512, 50_000
+        assert blocked_xent_enabled(batch, seq, vocab)  # no ambient mesh
+        assert not blocked_xent_enabled(batch, seq, vocab, shards=4)
+        mesh = make_mesh([("dp", 4), ("tp", 2)])
+        with use_mesh(mesh):
+            # ambient routing divides by dp*sp (tp does not shard (B, T))
+            assert not blocked_xent_enabled(batch, seq, vocab)
+            # explicit shards overrides the ambient mesh both directions
+            assert blocked_xent_enabled(batch, seq, vocab, shards=1)
+            assert not blocked_xent_enabled(batch, seq, vocab, shards=8)
+
     def test_sp_train_step_runs(self):
         from metaopt_tpu.models.transformer import train_and_eval
 

@@ -73,3 +73,25 @@ def test_dict_roundtrip():
     t2 = Trial.from_dict(t.to_dict())
     assert t2.to_dict() == t.to_dict()
     assert t2.objective == 1.0
+
+
+def test_clone_matches_dict_roundtrip_and_isolates():
+    """clone() is the MemoryLedger's defensive copy: it must equal the
+    from_dict(to_dict()) round-trip it replaced, and mutations of the
+    clone's nested params/results/resources must not reach the original."""
+    t = Trial(params={"x": [1.0, 2.0], "cfg": {"lr": 0.1}}, experiment="exp")
+    t.transition("reserved")
+    t.worker = "w1"
+    t.resources = {"chips": [0, 1]}
+    t.attach_results([{"name": "loss", "type": "objective", "value": 1.0}])
+    c = t.clone()
+    assert c is not t
+    assert c.to_dict() == t.to_dict()
+    assert c.to_dict() == Trial.from_dict(t.to_dict()).to_dict()
+    c.params["x"][0] = 99.0
+    c.params["cfg"]["lr"] = 99.0
+    c.resources["chips"].append(9)
+    c.results[0].value = 99.0
+    assert t.params == {"x": [1.0, 2.0], "cfg": {"lr": 0.1}}
+    assert t.resources == {"chips": [0, 1]}
+    assert t.objective == 1.0
