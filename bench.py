@@ -724,6 +724,26 @@ def main() -> None:
         coord_row = coord_reps[1]
         coord_stats["coord_trials_per_s_32w"] = coord_row["trials_per_s"]
         coord_stats["coord_rpcs_per_trial_32w"] = coord_row["rpcs_per_trial"]
+
+        # durability tax + recovery: same fused path with the WAL under
+        # it (group-commit fsync before every ack), then a cold restart
+        # replaying a 2000-record WAL. Same median-of-3 discipline; the
+        # overhead pct pairs this run's OWN fused median so one-core
+        # scheduler drift between sessions cancels out
+        wal_reps = sorted(
+            (coord_run_scale(32, "fused+wal", trials_per_worker=16)
+             for _ in range(3)),
+            key=lambda row: row["trials_per_s"] or 0,
+        )
+        wal_tps = wal_reps[1]["trials_per_s"]
+        if coord_row["trials_per_s"] and wal_tps:
+            coord_stats["coord_wal_overhead_pct"] = round(
+                100.0 * (1.0 - wal_tps / coord_row["trials_per_s"]), 1)
+
+        from benchmarks.coord_scale import run_recovery as coord_run_recovery
+
+        coord_stats["coord_recovery_time_s"] = coord_run_recovery(
+            trials=2000)["recovery_s"]
     except Exception as err:  # the TPE headline must survive a coord break
         coord_stats["coord_bench_error"] = f"{type(err).__name__}: {err}"
 
@@ -833,7 +853,8 @@ def main() -> None:
             compact[key] = src[key]
     # control-plane keys come from the LIVE extra, not the last-good TPU
     # record: they are host-CPU metrics, fresh on every run
-    for key in ("coord_trials_per_s_32w", "coord_rpcs_per_trial_32w"):
+    for key in ("coord_trials_per_s_32w", "coord_rpcs_per_trial_32w",
+                "coord_wal_overhead_pct", "coord_recovery_time_s"):
         if key in result["extra"]:
             compact[key] = result["extra"][key]
     print(json.dumps(compact))

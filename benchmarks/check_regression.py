@@ -36,6 +36,10 @@ import sys
 METRIC = "tpe_suggest_ms_per_point_10k_obs_pool8"
 #: coordinator control-plane throughput (higher is better, gated inversely)
 COORD_METRIC = "coord_trials_per_s_32w"
+#: durability metrics (informational until a committed baseline carries
+#: them; then the WAL tax gates like a regression — lower is better)
+WAL_METRIC = "coord_wal_overhead_pct"
+RECOVERY_METRIC = "coord_recovery_time_s"
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -56,8 +60,13 @@ def load_artifact(path: str) -> dict:
     extra = rec.get("extra") or {}
     backend = extra.get("backend") or rec.get("backend")
     coord = extra.get(COORD_METRIC)
+    wal = extra.get(WAL_METRIC)
+    recovery = extra.get(RECOVERY_METRIC)
     return {"value": float(rec["value"]), "backend": backend or "unknown",
-            "coord": float(coord) if coord else None, "path": path}
+            "coord": float(coord) if coord else None,
+            "wal_overhead": float(wal) if wal is not None else None,
+            "recovery": float(recovery) if recovery is not None else None,
+            "path": path}
 
 
 def round_baselines() -> list:
@@ -118,17 +127,42 @@ def main() -> int:
     if art.get("coord") is None or not coord_bases:
         print(f"{COORD_METRIC}: artifact or committed baseline missing the "
               "metric — nothing to gate against (pass)")
-        return rc
-    cb_name, _, _, cb_parsed = coord_bases[-1]
-    coord_base = float(cb_parsed[COORD_METRIC])
-    cratio = art["coord"] / coord_base
-    cverdict = (f"{COORD_METRIC}: {art['coord']:.0f} vs {coord_base:.0f} "
-                f"trials/s ({cb_name}, {art['backend']}) → {cratio:.3f}x")
-    if cratio < 1.0 - args.threshold:
-        print(f"FAIL {cverdict} — throughput regressed past the "
-              f"{args.threshold:.0%} threshold")
-        return 1
-    print(f"OK {cverdict}")
+    else:
+        cb_name, _, _, cb_parsed = coord_bases[-1]
+        coord_base = float(cb_parsed[COORD_METRIC])
+        cratio = art["coord"] / coord_base
+        cverdict = (f"{COORD_METRIC}: {art['coord']:.0f} vs {coord_base:.0f} "
+                    f"trials/s ({cb_name}, {art['backend']}) → {cratio:.3f}x")
+        if cratio < 1.0 - args.threshold:
+            print(f"FAIL {cverdict} — throughput regressed past the "
+                  f"{args.threshold:.0%} threshold")
+            rc = 1
+        else:
+            print(f"OK {cverdict}")
+
+    # durability metrics: the WAL tax gates against the last committed
+    # baseline that carries it (lower is better, absolute pct-point slack
+    # of `threshold * 100` — a 5pt tax drifting to 6pt is noise, not a
+    # regression); recovery time is informational. Baselines predating
+    # the metrics pass informationally
+    wal_bases = [b for b in matching if b[3].get(WAL_METRIC) is not None]
+    if art.get("wal_overhead") is None or not wal_bases:
+        print(f"{WAL_METRIC}: artifact or committed baseline missing the "
+              "metric — nothing to gate against (pass)")
+    else:
+        wb_name, _, _, wb_parsed = wal_bases[-1]
+        wal_base = float(wb_parsed[WAL_METRIC])
+        wverdict = (f"{WAL_METRIC}: {art['wal_overhead']:.1f}% vs "
+                    f"{wal_base:.1f}% ({wb_name}, {art['backend']})")
+        if art["wal_overhead"] > wal_base + args.threshold * 100.0:
+            print(f"FAIL {wverdict} — WAL tax grew past the baseline by "
+                  f"more than {args.threshold * 100:.0f} points")
+            rc = 1
+        else:
+            print(f"OK {wverdict}")
+    if art.get("recovery") is not None:
+        print(f"{RECOVERY_METRIC}: {art['recovery']:.2f}s "
+              "(informational — cold restore + WAL replay)")
     return rc
 
 

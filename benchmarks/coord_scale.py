@@ -31,9 +31,16 @@ the comparison free of a fixed sleep floor both modes would pay
 identically (coalescing is covered by sweep_scale + the
 coalesced-vs-serial property tests).
 
+"fused+wal" is the shipped configuration with the write-ahead log on
+(snapshot+WAL in a tempdir, group-commit fsync on every mutating reply);
+the fused vs fused+wal delta is the durability tax, gated at <10% by
+benchmarks/check_regression.py. `--recovery` additionally times a crash
+restart (restore + replay of a 2000-trial WAL).
+
     python benchmarks/coord_scale.py [--workers 1 8 32]
-                                     [--modes serial fused]
-                                     [--trials-per-worker 16] [--save]
+                                     [--modes serial fused fused+wal]
+                                     [--trials-per-worker 16]
+                                     [--recovery] [--save]
 
 Emits one JSON line per (mode, workers) config:
   {"mode": ..., "workers": N, "trials": ..., "wall_s": ...,
@@ -79,9 +86,24 @@ def _percentile(sorted_vals, q):
 def _make_server(mode: str, produce_coalesce_ms: float):
     """The coordinator under test; ``serial`` gets the pre-fast-path
     dispatch shape so the baseline is the pre-change server, not the new
-    server driven serially."""
+    server driven serially. ``fused+wal`` is the shipped server with the
+    write-ahead log on (group-commit fsync before every mutating reply) —
+    the fused/fused+wal ratio is the durability tax the regression gate
+    bounds at 10%."""
+    import shutil
+    import tempfile
+
     from metaopt_tpu.coord import CoordServer
 
+    if mode == "fused+wal":
+        wal_dir = tempfile.mkdtemp(prefix="coordscale-wal-")
+        server = CoordServer(
+            produce_coalesce_ms=produce_coalesce_ms,
+            snapshot_path=os.path.join(wal_dir, "snap.json"),
+        )
+        # benched state is throwaway: drop snapshot+WAL with the server
+        server._bench_cleanup = lambda: shutil.rmtree(wal_dir, True)
+        return server
     if mode == "fused":
         return CoordServer(produce_coalesce_ms=produce_coalesce_ms)
 
@@ -125,7 +147,7 @@ def run_scale(
     from metaopt_tpu.space import build_space
     from metaopt_tpu.worker import workon
 
-    if mode not in ("serial", "fused"):
+    if mode not in ("serial", "fused", "fused+wal"):
         raise ValueError(f"unknown mode {mode!r}")
 
     lat_lock = threading.Lock()
@@ -229,10 +251,76 @@ def run_scale(
             "rpcs": n_calls,
             "rpcs_per_trial": round(steady / completed, 2) if completed else None,
             "op_counts": ops,
-            "enc_cache_hits": server._enc_hits if mode == "fused" else None,
+            "enc_cache_hits": (server._enc_hits
+                               if mode.startswith("fused") else None),
+            "wal_batches": (server._wal.batches
+                            if getattr(server, "_wal", None) else None),
+            "wal_records": (server._wal.records
+                            if getattr(server, "_wal", None) else None),
         }
     finally:
         server.stop()
+        cleanup = getattr(server, "_bench_cleanup", None)
+        if cleanup:
+            cleanup()
+
+
+def run_recovery(trials: int = 2000, seed: int = 0) -> dict:
+    """Crash-recovery latency: load a durable coordinator with ``trials``
+    registered trials, kill it without the shutdown snapshot (the WAL is
+    the only record), and time the restart's restore + WAL replay.
+
+    The reported ``recovery_s`` is the window a restarting coordinator is
+    unreachable on top of process spawn — the figure the runbook quotes.
+    """
+    import shutil
+    import tempfile
+
+    from metaopt_tpu.coord import CoordServer
+    from metaopt_tpu.ledger import Trial
+
+    wal_dir = tempfile.mkdtemp(prefix="coordscale-recovery-")
+    snap = os.path.join(wal_dir, "snap.json")
+    try:
+        server = CoordServer(snapshot_path=snap)
+        server.start()
+        try:
+            # straight through the ledger facade: the workload here is the
+            # WAL/replay volume, not the RPC plane run_scale already covers
+            server.ledger.create_experiment(
+                {"name": "recov", "max_trials": trials + 1})
+            for i in range(trials):
+                server.ledger.register(
+                    Trial(params={"x": float(i)}, experiment="recov"))
+            wal_path = server.wal_path
+            wal_records = server._wal.records + len(server._wal._pending)
+        finally:
+            server.snapshot_path = None  # crash: skip the final snapshot
+            server.stop()
+        wal_bytes = os.path.getsize(wal_path)
+
+        t0 = time.perf_counter()
+        restarted = CoordServer(snapshot_path=snap)
+        restarted.start()
+        recovery_s = time.perf_counter() - t0
+        try:
+            recovered = restarted.ledger.count("recov")
+        finally:
+            restarted.snapshot_path = None
+            restarted.stop()
+        if recovered != trials:
+            raise RuntimeError(
+                f"recovery dropped trials: {recovered}/{trials}")
+        return {
+            "mode": "recovery",
+            "trials": trials,
+            "wal_bytes": wal_bytes,
+            "wal_records": wal_records,
+            "recovery_s": round(recovery_s, 3),
+            "trials_per_s_replayed": round(trials / recovery_s, 1),
+        }
+    finally:
+        shutil.rmtree(wal_dir, True)
 
 
 def main():
@@ -245,6 +333,11 @@ def main():
         "--repeats", type=int, default=1,
         help="runs per config; the median-throughput row is reported "
              "(one-core boxes jitter ±10%% run to run)",
+    )
+    ap.add_argument(
+        "--recovery", action="store_true",
+        help="also time crash recovery (restore + WAL replay) of a "
+             "2000-trial log",
     )
     ap.add_argument("--save", action="store_true")
     args = ap.parse_args()
@@ -301,6 +394,25 @@ def main():
             "fused_rpcs_per_trial": f.get("rpcs_per_trial"),
             "serial_rpcs_per_trial": s.get("rpcs_per_trial"),
         }), flush=True)
+    # the durability tax: fused+wal vs fused in the same run — the gate
+    # benchmarks/check_regression.py bounds at 10%
+    w = by.get(("fused+wal", widest))
+    if f and w and f.get("trials_per_s") and w.get("trials_per_s"):
+        print(json.dumps({
+            "summary": f"wal_overhead_{widest}w",
+            "wal_overhead_pct": round(
+                100.0 * (1.0 - w["trials_per_s"] / f["trials_per_s"]), 1),
+            "fused_trials_per_s": f["trials_per_s"],
+            "fused_wal_trials_per_s": w["trials_per_s"],
+            "wal_batches": w.get("wal_batches"),
+            "wal_records": w.get("wal_records"),
+        }), flush=True)
+    if args.recovery:
+        row = run_recovery()
+        from metaopt_tpu.utils.provenance import provenance
+        row.update(provenance())
+        print(json.dumps(row), flush=True)
+        rows.append(row)
     if args.save:
         stamp = time.strftime("%Y-%m-%d")
         path = os.path.join(REPO, "benchmarks", "results",

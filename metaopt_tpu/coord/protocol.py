@@ -25,6 +25,19 @@ A reply may be served as preencoded bytes (:func:`send_payload`) when the
 server's per-commit reply cache hits — the wire format is identical, the
 JSON encode is just paid once per ledger mutation instead of once per
 observer.
+
+**Durability semantics** (WAL-enabled servers — see
+:mod:`metaopt_tpu.coord.wal`): once the reply to a mutating op (or to
+``worker_cycle``/``produce``) is on the wire, the mutation AND its
+request-id reply-cache entry are fsynced — a client that received an ack
+can rely on the write surviving a coordinator kill -9, and a retry that
+straddles the crash is answered from the journaled reply cache with the
+original reply (exactly-once across restarts). The ``ping`` reply carries
+``incarnation`` (a per-process-start id) and ``durable`` (whether a WAL is
+active): a client that reconnects and observes a changed incarnation knows
+it crossed a restart, not just a dropped connection, and runs session
+resumption (re-learn caps, re-assert held reservations via heartbeats).
+Wire framing is unchanged — both fields are ignored by older clients.
 """
 
 from __future__ import annotations

@@ -1,0 +1,325 @@
+"""Write-ahead log for the coordinator — the durability layer under
+:class:`~metaopt_tpu.coord.server.CoordServer`.
+
+Before this module, a coordinator crash lost up to ``snapshot_interval_s``
+(30s) of *acknowledged* writes plus the whole in-memory reply cache — so the
+exactly-once guarantee the fused ``worker_cycle`` op builds on silently
+broke across restarts. The WAL closes that hole: every acknowledged mutation
+is on disk before its reply leaves the sender thread, and recovery is
+``restore(snapshot) + replay(WAL tail)``.
+
+Record format (one line per record)::
+
+    {crc32:08x} {compact JSON}\\n
+
+The crc covers the JSON payload bytes; a torn tail (partial last batch after
+a kill -9 or power cut) fails the crc or the JSON parse and
+:func:`read_records` physically truncates the file at the first bad line —
+everything before it was group-commit fsynced and is intact by construction.
+Each record carries a monotonic ``seq``; a snapshot embeds the highest
+``seq`` it reflects (``wal_seq``), so replay applies only the tail and the
+log is compacted down to that tail after every snapshot.
+
+Group commit reuses the leader/latecomer window pattern of the server's
+``_ProduceCoalescer``: the first thread that needs durability becomes the
+leader, optionally sleeps ``group_window_s``, then writes + fsyncs EVERY
+record appended so far in one batch; threads that arrive while the leader is
+in fsync wait on the condition variable and are released together when the
+batch lands. Under fan-in the fsync cost therefore amortizes across the same
+burst of requests that already coalesces produce calls — with the default
+``group_window_s=0`` the fsync duration itself is the batching window (while
+one fsync runs, the next batch accumulates), which keeps single-client
+latency unchanged.
+
+Appends are buffer-only (one lock, no I/O) and may be called under the
+server's per-experiment locks; ``sync()`` does the I/O and must be called
+OUTSIDE them (the server calls it from each connection's sender thread).
+
+No background threads: group commit runs on caller threads, so the module
+adds nothing to the coordinator's thread census (tests assert no leaked
+``coord-*`` threads per test).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+def _frame(rec: Dict[str, Any]) -> bytes:
+    payload = json.dumps(rec, separators=(",", ":"), default=str).encode()
+    return b"%08x %s\n" % (zlib.crc32(payload), payload)
+
+
+def read_records(path: str, truncate_torn: bool = True
+                 ) -> Tuple[List[Dict[str, Any]], int]:
+    """Parse a WAL file; returns ``(records, torn_bytes)``.
+
+    Stops at the first line whose crc or JSON fails — the torn tail of a
+    crash mid-batch — and (by default) truncates the file there so a later
+    append never interleaves new records with torn garbage. ``torn_bytes``
+    is how many bytes were dropped (0 = clean log).
+    """
+    records: List[Dict[str, Any]] = []
+    good_end = 0
+    torn = 0
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return records, 0
+    pos = 0
+    size = len(data)
+    while pos < size:
+        nl = data.find(b"\n", pos)
+        line = data[pos:nl] if nl != -1 else data[pos:]
+        end = (nl + 1) if nl != -1 else size
+        try:
+            crc_hex, payload = line.split(b" ", 1)
+            if int(crc_hex, 16) != zlib.crc32(payload):
+                raise ValueError("crc mismatch")
+            rec = json.loads(payload)
+        except (ValueError, json.JSONDecodeError):
+            torn = size - pos
+            break
+        records.append(rec)
+        good_end = end
+        pos = end
+    if torn and truncate_torn:
+        log.warning("WAL %s: torn tail (%d bytes after record %d) truncated",
+                    path, torn, records[-1].get("seq", 0) if records else 0)
+        with open(path, "r+b") as f:
+            f.truncate(good_end)
+            f.flush()
+            os.fsync(f.fileno())
+    return records, torn
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the parent directory so a rename/creat is itself durable."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds — best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class WriteAheadLog:
+    """Append-buffered, group-commit-fsynced redo log.
+
+    ``append(rec)`` is cheap (stamp a seq, frame, buffer) and safe under
+    ledger locks; ``sync(target_seq)`` blocks until every record up to
+    ``target_seq`` is fsynced, electing one caller as the batch leader.
+    ``fsync=False`` keeps the write ordering but skips the fsync — for
+    benchmarks isolating the syscall cost, never for production.
+    """
+
+    def __init__(self, path: str, fsync: bool = True,
+                 group_window_s: float = 0.0) -> None:
+        self.path = path
+        self.fsync = fsync
+        self.group_window_s = group_window_s
+        self._buf_lock = threading.Lock()   # buffer + seq counter
+        self._cv = threading.Condition()    # group-commit leader election
+        self._pending: List[bytes] = []
+        self._next_seq = 1
+        self._appended = 0   # last seq handed out
+        self._durable = 0    # last seq known fsynced
+        self._syncing = False
+        self._failed = False  # fsync/write failed: journaling degraded
+        self._f: Optional[Any] = None
+        self.batches = 0     # fsync batches written (amortization telemetry)
+        self.records = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def open(self, next_seq: int = 1) -> "WriteAheadLog":
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "ab")
+        self._next_seq = max(1, next_seq)
+        self._appended = self._durable = self._next_seq - 1
+        return self
+
+    def close(self) -> None:
+        with self._cv:
+            while self._syncing:
+                self._cv.wait(timeout=1.0)
+            self._syncing = True
+        try:
+            with self._buf_lock:
+                batch, self._pending = self._pending, []
+                upto = self._appended
+            if self._f is not None:
+                try:
+                    self._write_batch(batch)
+                    self._durable = max(self._durable, upto)
+                except OSError:
+                    log.exception("WAL close flush failed")
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+        finally:
+            with self._cv:
+                self._syncing = False
+                self._cv.notify_all()
+
+    @property
+    def appended_seq(self) -> int:
+        return self._appended
+
+    @property
+    def durable_seq(self) -> int:
+        return self._durable
+
+    # -- hot path ---------------------------------------------------------
+    def append(self, rec: Dict[str, Any]) -> int:
+        """Stamp + buffer one record; returns its seq. No I/O here —
+        callers that need durability follow with ``sync(seq)`` outside any
+        ledger lock."""
+        if self._f is None or self._failed:
+            return 0
+        with self._buf_lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            rec["seq"] = seq
+            self._pending.append(_frame(rec))
+            self._appended = seq
+        return seq
+
+    def sync(self, target_seq: int) -> None:
+        """Block until every record up to ``target_seq`` is fsynced.
+
+        Leader/latecomer group commit: the first waiter becomes leader,
+        optionally sleeps the window out, then writes + fsyncs the WHOLE
+        pending buffer (including records appended by threads that arrived
+        during the wait); latecomers block on the condition variable and
+        are all released when the batch lands.
+        """
+        if target_seq <= 0 or self._f is None:
+            return
+        while True:
+            with self._cv:
+                if self._durable >= target_seq or self._failed:
+                    return
+                if self._syncing:
+                    self._cv.wait(timeout=1.0)
+                    continue
+                self._syncing = True
+            break
+        # leader from here
+        try:
+            if self.group_window_s > 0:
+                # let the burst pile in — same amortization window doctrine
+                # as _ProduceCoalescer (0 = fsync-duration batching only)
+                import time as _time
+                _time.sleep(self.group_window_s)
+            # one batch per leader, then hand off: keeping the leader role
+            # across batches was measured SLOWER at 32-worker fan-in (the
+            # leader's own acked client idles while it writes strangers'
+            # batches, draining the pipeline)
+            with self._buf_lock:
+                batch, self._pending = self._pending, []
+                upto = self._appended
+            if batch:
+                self._write_batch(batch)
+            with self._cv:
+                self._durable = max(self._durable, upto)
+                self._cv.notify_all()
+        except OSError:
+            # durability is degraded, the service stays up: callers stop
+            # waiting (and the server logs loudly) rather than deadlocking
+            # every reply behind a dead disk
+            log.exception("WAL write/fsync failed — durability degraded")
+            self._failed = True
+        finally:
+            with self._cv:
+                self._syncing = False
+                self._cv.notify_all()
+
+    def _write_batch(self, batch: List[bytes]) -> None:
+        if not batch:
+            return
+        data = b"".join(batch)
+        from metaopt_tpu.executor.faults import faults
+
+        if faults.fire("torn_wal_tail"):
+            # chaos: die mid-batch — half the bytes land, then SIGKILL.
+            # Recovery must truncate the torn half-record and keep
+            # everything previously acknowledged.
+            self._f.write(data[: max(1, len(data) // 2)])
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            os.kill(os.getpid(), signal.SIGKILL)
+        self._f.write(data)
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self.batches += 1
+        self.records += len(batch)
+
+    # -- maintenance ------------------------------------------------------
+    def compact(self, upto_seq: int) -> None:
+        """Drop every record with ``seq <= upto_seq`` (they are reflected
+        in the snapshot stamped ``wal_seq=upto_seq``); keep the tail.
+
+        Takes the leader role so no concurrent batch writes interleave
+        with the rewrite; appends keep buffering meanwhile and land in the
+        fresh file on the next sync.
+        """
+        if self._f is None:
+            return
+        while True:
+            with self._cv:
+                if self._syncing:
+                    self._cv.wait(timeout=1.0)
+                    continue
+                self._syncing = True
+            break
+        upto = 0
+        try:
+            # flush the buffer first so the rewrite sees every record
+            with self._buf_lock:
+                batch, self._pending = self._pending, []
+                upto = self._appended
+            try:
+                self._write_batch(batch)
+            except OSError:
+                log.exception("WAL compact flush failed")
+                self._failed = True
+                return
+            records, _ = read_records(self.path, truncate_torn=False)
+            tail = [r for r in records if r.get("seq", 0) > upto_seq]
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                for r in tail:
+                    f.write(_frame(r))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            fsync_dir(self.path)
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = open(self.path, "ab")
+        except OSError:
+            log.exception("WAL compaction failed (log kept as-is)")
+        finally:
+            with self._cv:
+                if not self._failed:
+                    self._durable = max(self._durable, upto)
+                self._syncing = False
+                self._cv.notify_all()

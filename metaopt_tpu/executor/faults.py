@@ -13,13 +13,33 @@ Usage (tests or chaos runs):
     faults.arm("drop_heartbeat", times=2)    # next 2 heartbeats report lost
     faults.arm("spawn_fail", times=1)        # next spawn errors out
 
-or via env (picked up at import, for subprocess-launched workers):
+or via env (picked up at import, for subprocess-launched workers AND
+subprocess-launched coordinators):
 
     METAOPT_TPU_FAULTS="kill_trial:1,drop_heartbeat:2"
+    METAOPT_TPU_FAULTS="crash_server:1@5"    # skip 5 firings, then fire
 
-Each armed rule fires ``times`` times then disarms. ``fire(kind)`` is the
-single hook executors consult; it is thread-safe and cheap when nothing is
-armed (one dict lookup).
+Each armed rule fires ``times`` times then disarms; an optional ``@skip``
+suffix (or ``arm(..., skip=N)``) swallows the first N firings first — how
+the crash-chaos sweep kills a coordinator at EVERY injection point in turn
+(skip=0 dies at the first barrier, skip=1 at the second, …).
+``fire(kind)`` is the single hook executors consult; it is thread-safe and
+cheap when nothing is armed (one dict lookup).
+
+Coordinator durability kinds (consumed in ``coord/server.py`` and
+``coord/wal.py``; each SIGKILLs the process at a crash-consistent point,
+so arm them only in a subprocess-hosted server):
+
+- ``crash_server``: die in the connection sender thread AFTER the WAL
+  durability barrier but BEFORE the reply is sent — the write is durable,
+  the ack is lost; the client's retry must be answered from the journaled
+  reply cache after restart.
+- ``torn_wal_tail``: die mid-WAL-batch with only half the batch's bytes
+  written — recovery must truncate the torn tail and keep every
+  previously-acknowledged record.
+- ``partial_snapshot``: die mid-snapshot with a truncated ``.tmp`` on
+  disk, before the atomic rename — recovery must ignore the torn tmp and
+  come back from the previous snapshot + un-compacted WAL.
 """
 
 from __future__ import annotations
@@ -38,22 +58,30 @@ class FaultInjector:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._armed: Dict[str, int] = {}
+        self._skip: Dict[str, int] = {}
         self._fired: Dict[str, int] = {}
         env = os.environ.get(FAULTS_ENV, "")
         for part in env.split(","):
             part = part.strip()
             if not part:
                 continue
-            kind, _, n = part.partition(":")
+            kind, _, spec = part.partition(":")
+            times, _, skip = spec.partition("@")
             try:
-                self._armed[kind] = int(n) if n else 1
+                self._armed[kind] = int(times) if times else 1
+                if skip:
+                    self._skip[kind] = int(skip)
             except ValueError:
                 # a chaos-test env typo must not kill the worker at import
                 log.warning("ignoring malformed %s entry %r", FAULTS_ENV, part)
 
-    def arm(self, kind: str, times: int = 1) -> None:
+    def arm(self, kind: str, times: int = 1, skip: int = 0) -> None:
+        """Arm ``kind`` to fire ``times`` times, after swallowing its first
+        ``skip`` firings (the injection-point selector for chaos sweeps)."""
         with self._lock:
             self._armed[kind] = self._armed.get(kind, 0) + times
+            if skip:
+                self._skip[kind] = self._skip.get(kind, 0) + skip
 
     def fire(self, kind: str) -> bool:
         """Consume one charge of ``kind``; True = the fault should happen."""
@@ -62,6 +90,10 @@ class FaultInjector:
         with self._lock:
             n = self._armed.get(kind, 0)
             if n <= 0:
+                return False
+            s = self._skip.get(kind, 0)
+            if s > 0:
+                self._skip[kind] = s - 1
                 return False
             if n == 1:
                 del self._armed[kind]
@@ -78,6 +110,7 @@ class FaultInjector:
     def reset(self) -> None:
         with self._lock:
             self._armed.clear()
+            self._skip.clear()
             self._fired.clear()
 
 

@@ -161,6 +161,22 @@ class LedgerBackend(ABC):
                     released.append(t)
         return released
 
+    def put_trial(self, trial: Trial) -> None:
+        """Upsert: register if absent, else overwrite unconditionally.
+
+        The redo-replay primitive behind the coordinator's WAL recovery
+        (:mod:`metaopt_tpu.coord.wal`): nondeterministic mutations
+        (``reserve``, ``release_stale``) journal their RESULTING document
+        state, and replaying that state must be idempotent — applying the
+        same record twice, or over a snapshot that already reflects it,
+        lands on the identical document. Not part of the client-facing
+        contract (workers keep using the CAS-guarded ``update_trial``).
+        """
+        try:
+            self.register(trial)
+        except DuplicateTrialError:
+            self.update_trial(trial)
+
 
 # ---------------------------------------------------------------------------
 

@@ -177,243 +177,256 @@ def workon(
         if to_status in c:
             c[to_status] += 1
 
-    while True:
-        if last_cycle is not None:
-            if _cycle_done(last_cycle):
-                break
-        elif experiment.is_done:
-            break
-        if stop_event is not None and stop_event.is_set():
-            log.info("%s: stop requested — winding down", worker_id)
-            break
-        if worker_trials is not None and stats.reserved >= worker_trials:
-            log.info("%s: worker_trials cap (%d) reached", worker_id, worker_trials)
-            break
-        if max_broken is not None and stats.broken >= max_broken:
-            log.error(
-                "%s: %d trials broke (max_broken=%d) — is the user script "
-                "runnable? Stopping. Last failure: %s", worker_id,
-                stats.broken, max_broken, last_broken_note or "(no detail)",
-            )
-            break
-
-        # pacemaker duty, throttled: a stale reservation is minutes old by
-        # definition (heartbeat_timeout_s), so sweeping every cycle buys
-        # nothing and costs an RPC/lock round-trip per cycle — on the
-        # coord backend that was one of ~5 RPCs per trial
-        now = time.time()
-        sweep = now - last_sweep >= stale_sweep_interval_s
-        if fused:
-            # skip the produce leg when the registration budget is provably
-            # exhausted: completed+new+reserved only grows (requeues move
-            # within the sum), so a one-cycle-stale sum >= max_trials still
-            # proves no suggest can register — the produce would be a pure
-            # no-op observe. Only when the server says the algorithm is
-            # passive (``algo_passive``: no judge/suspend verdicts consult
-            # the fit between produces), so observe timing is unobservable
-            # and the suggestion stream provably identical. Trials leaving
-            # the sum (broken/interrupted) reopen budget; the next reply's
-            # fresh counts catch that one cycle later.
-            produce_cycle = True
-            if (last_cycle is not None
-                    and last_cycle.get("algo_passive")
-                    and experiment.max_trials is not None):
-                c = last_cycle["counts"]
-                produce_cycle = (
-                    c["new"] + c["reserved"] + c["completed"]
-                    < experiment.max_trials
-                )
-            complete = None
-            if pending_push is not None:
-                complete = {
-                    "trial": pending_push[0].to_dict(),
-                    "expected_status": "reserved",
-                    "expected_worker": worker_id,
-                }
-            last_cycle = producer.cycle(
-                stale_timeout_s=heartbeat_timeout_s if sweep else None,
-                produce=produce_cycle,
-                complete=complete,
-            )
-            if complete is not None:
-                _resolve_push(bool(last_cycle.get("completed_ok")))
-            produced = last_cycle["registered"]
-            trial = last_cycle["trial"]
-        else:
-            if sweep:
-                experiment.ledger.release_stale(
-                    experiment.name, heartbeat_timeout_s
-                )
-            produced = producer.produce()
-            trial = experiment.reserve_trial(worker_id)
-        if sweep:
-            last_sweep = now
-
-        if trial is None:
-            # nothing to run: either in-flight trials elsewhere, an algorithm
-            # barrier (sync rungs / generation waits), or true exhaustion
-            in_flight = (
-                last_cycle["counts"]["reserved"]
-                if last_cycle is not None
-                else experiment.count("reserved")
-            )
-            if produced == 0 and in_flight == 0:
-                stats.idle_cycles += 1
-                if producer.algo_done or stats.idle_cycles > max_idle_cycles:
-                    log.info("%s: no work producible; stopping", worker_id)
+    try:
+        while True:
+            if last_cycle is not None:
+                if _cycle_done(last_cycle):
                     break
-            else:
-                stats.idle_cycles = 0
-            time.sleep(idle_sleep_s)
-            continue
+            elif experiment.is_done:
+                break
+            if stop_event is not None and stop_event.is_set():
+                log.info("%s: stop requested — winding down", worker_id)
+                break
+            if worker_trials is not None and stats.reserved >= worker_trials:
+                log.info("%s: worker_trials cap (%d) reached", worker_id, worker_trials)
+                break
+            if max_broken is not None and stats.broken >= max_broken:
+                log.error(
+                    "%s: %d trials broke (max_broken=%d) — is the user script "
+                    "runnable? Stopping. Last failure: %s", worker_id,
+                    stats.broken, max_broken, last_broken_note or "(no detail)",
+                )
+                break
 
-        stats.idle_cycles = 0
-        stats.reserved += 1
-        suspend = (
-            last_cycle["suspend"]  # verdict rode the fused reply
-            if last_cycle is not None
-            else producer.should_suspend(trial)
-        )
-        if suspend:
-            # the algorithm wants this trial parked (e.g. a bracket wants
-            # its budget elsewhere first): suspended, not executed;
-            # ``mtpu resume`` flips suspended trials back to new
-            trial.transition("suspended")
-            experiment.ledger.update_trial(
-                trial, expected_status="reserved", expected_worker=worker_id
-            )
-            stats.suspended += 1
-            _settle("suspended")
-            continue
-        log.debug("%s running trial %s %s", worker_id, trial.id[:8], trial.params)
-        t0 = time.time()
-        try:
-            res = executor.execute(
-                trial,
-                heartbeat=heartbeat_for(
-                    trial,
-                    # safe to answer the executor's immediate first beat
-                    # locally: the fused reply just told us this fresh
-                    # reservation has no pending signal
-                    primed=(last_cycle is not None
-                            and last_cycle.get("fused", False)
-                            and last_cycle.get("signal") is None),
-                ),
-                judge=judge_fn,
-            )
-        except KeyboardInterrupt:
-            trial.transition("interrupted")
-            experiment.ledger.update_trial(
-                trial, expected_status="reserved", expected_worker=worker_id
-            )
-            stats.interrupted += 1
-            raise
-
-        trial.exit_code = res.exit_code
-        requeue_budget_spent = False
-        if res.status == "completed":
+            # pacemaker duty, throttled: a stale reservation is minutes old by
+            # definition (heartbeat_timeout_s), so sweeping every cycle buys
+            # nothing and costs an RPC/lock round-trip per cycle — on the
+            # coord backend that was one of ~5 RPCs per trial
+            now = time.time()
+            sweep = now - last_sweep >= stale_sweep_interval_s
             if fused:
-                # defer the terminal update: it rides the next worker_cycle
-                # (the cycle is due immediately anyway), so the steady-state
-                # coord cost is ~1 RPC per trial instead of 2. The server
-                # applies it before its produce/reserve legs — same order
-                # as push-then-cycle — and the reply's counts/doneness
-                # already include it, so no _settle here.
-                trial.attach_results(res.results)
-                trial.transition("completed")
-                pending_push = (trial, int("pruned" in res.note))
+                # skip the produce leg when the registration budget is provably
+                # exhausted: completed+new+reserved only grows (requeues move
+                # within the sum), so a one-cycle-stale sum >= max_trials still
+                # proves no suggest can register — the produce would be a pure
+                # no-op observe. Only when the server says the algorithm is
+                # passive (``algo_passive``: no judge/suspend verdicts consult
+                # the fit between produces), so observe timing is unobservable
+                # and the suggestion stream provably identical. Trials leaving
+                # the sum (broken/interrupted) reopen budget; the next reply's
+                # fresh counts catch that one cycle later.
+                produce_cycle = True
+                if (last_cycle is not None
+                        and last_cycle.get("algo_passive")
+                        and experiment.max_trials is not None):
+                    c = last_cycle["counts"]
+                    produce_cycle = (
+                        c["new"] + c["reserved"] + c["completed"]
+                        < experiment.max_trials
+                    )
+                complete = None
+                if pending_push is not None:
+                    complete = {
+                        "trial": pending_push[0].to_dict(),
+                        "expected_status": "reserved",
+                        "expected_worker": worker_id,
+                    }
+                last_cycle = producer.cycle(
+                    stale_timeout_s=heartbeat_timeout_s if sweep else None,
+                    produce=produce_cycle,
+                    complete=complete,
+                )
+                if complete is not None:
+                    _resolve_push(bool(last_cycle.get("completed_ok")))
+                produced = last_cycle["registered"]
+                trial = last_cycle["trial"]
             else:
-                ok = experiment.push_results(trial, res.results)
+                if sweep:
+                    experiment.ledger.release_stale(
+                        experiment.name, heartbeat_timeout_s
+                    )
+                produced = producer.produce()
+                trial = experiment.reserve_trial(worker_id)
+            if sweep:
+                last_sweep = now
+
+            if trial is None:
+                # nothing to run: either in-flight trials elsewhere, an algorithm
+                # barrier (sync rungs / generation waits), or true exhaustion
+                in_flight = (
+                    last_cycle["counts"]["reserved"]
+                    if last_cycle is not None
+                    else experiment.count("reserved")
+                )
+                if produced == 0 and in_flight == 0:
+                    stats.idle_cycles += 1
+                    if producer.algo_done or stats.idle_cycles > max_idle_cycles:
+                        log.info("%s: no work producible; stopping", worker_id)
+                        break
+                else:
+                    stats.idle_cycles = 0
+                time.sleep(idle_sleep_s)
+                continue
+
+            stats.idle_cycles = 0
+            stats.reserved += 1
+            suspend = (
+                last_cycle["suspend"]  # verdict rode the fused reply
+                if last_cycle is not None
+                else producer.should_suspend(trial)
+            )
+            if suspend:
+                # the algorithm wants this trial parked (e.g. a bracket wants
+                # its budget elsewhere first): suspended, not executed;
+                # ``mtpu resume`` flips suspended trials back to new
+                trial.transition("suspended")
+                experiment.ledger.update_trial(
+                    trial, expected_status="reserved", expected_worker=worker_id
+                )
+                stats.suspended += 1
+                _settle("suspended")
+                continue
+            log.debug("%s running trial %s %s", worker_id, trial.id[:8], trial.params)
+            t0 = time.time()
+            try:
+                res = executor.execute(
+                    trial,
+                    heartbeat=heartbeat_for(
+                        trial,
+                        # safe to answer the executor's immediate first beat
+                        # locally: the fused reply just told us this fresh
+                        # reservation has no pending signal
+                        primed=(last_cycle is not None
+                                and last_cycle.get("fused", False)
+                                and last_cycle.get("signal") is None),
+                    ),
+                    judge=judge_fn,
+                )
+            except KeyboardInterrupt:
+                trial.transition("interrupted")
+                experiment.ledger.update_trial(
+                    trial, expected_status="reserved", expected_worker=worker_id
+                )
+                stats.interrupted += 1
+                raise
+
+            trial.exit_code = res.exit_code
+            requeue_budget_spent = False
+            if res.status == "completed":
+                if fused:
+                    # defer the terminal update: it rides the next worker_cycle
+                    # (the cycle is due immediately anyway), so the steady-state
+                    # coord cost is ~1 RPC per trial instead of 2. The server
+                    # applies it before its produce/reserve legs — same order
+                    # as push-then-cycle — and the reply's counts/doneness
+                    # already include it, so no _settle here.
+                    trial.attach_results(res.results)
+                    trial.transition("completed")
+                    pending_push = (trial, int("pruned" in res.note))
+                else:
+                    ok = experiment.push_results(trial, res.results)
+                    if ok:
+                        stats.completed += 1
+                        _settle("completed")
+                        if "pruned" in res.note:
+                            stats.pruned += 1
+                    else:
+                        log.warning(
+                            "%s lost reservation of %s before result push",
+                            worker_id, trial.id,
+                        )
+            elif (res.requeue
+                  and int(trial.resources.get("requeues", 0)) < max_requeues):
+                # infrastructure failure (device wedge/park budget): release
+                # the trial back to 'new' so this or another worker retries it
+                # once the device recovers; bounded per trial so a permanently
+                # dead backend still converges to interrupted
+                n_req = int(trial.resources.get("requeues", 0)) + 1
+                trial.reset_to_new()
+                # AFTER reset_to_new, which clears resources — the counter
+                # must survive into the ledger or the budget never binds
+                trial.resources["requeues"] = n_req
+                ok = experiment.ledger.update_trial(
+                    trial, expected_status="reserved", expected_worker=worker_id
+                )
                 if ok:
-                    stats.completed += 1
-                    _settle("completed")
-                    if "pruned" in res.note:
-                        stats.pruned += 1
+                    stats.requeued += 1
+                    _settle("new")
+                    log.warning(
+                        "%s requeued trial %s (%d/%d): %s", worker_id,
+                        trial.id[:8], n_req, max_requeues, res.note,
+                    )
                 else:
                     log.warning(
-                        "%s lost reservation of %s before result push",
+                        "%s lost reservation of %s before requeue write-back",
                         worker_id, trial.id,
                     )
-        elif (res.requeue
-              and int(trial.resources.get("requeues", 0)) < max_requeues):
-            # infrastructure failure (device wedge/park budget): release
-            # the trial back to 'new' so this or another worker retries it
-            # once the device recovers; bounded per trial so a permanently
-            # dead backend still converges to interrupted
-            n_req = int(trial.resources.get("requeues", 0)) + 1
-            trial.reset_to_new()
-            # AFTER reset_to_new, which clears resources — the counter
-            # must survive into the ledger or the budget never binds
-            trial.resources["requeues"] = n_req
-            ok = experiment.ledger.update_trial(
-                trial, expected_status="reserved", expected_worker=worker_id
-            )
-            if ok:
-                stats.requeued += 1
-                _settle("new")
-                log.warning(
-                    "%s requeued trial %s (%d/%d): %s", worker_id,
-                    trial.id[:8], n_req, max_requeues, res.note,
-                )
             else:
-                log.warning(
-                    "%s lost reservation of %s before requeue write-back",
-                    worker_id, trial.id,
+                if res.requeue:
+                    # the executor flagged a retry, but the shared budget is
+                    # spent — the stored outcome must say what actually
+                    # happens (nothing, until a human resumes it)
+                    res.note += (" (requeue budget exhausted — "
+                                 "see `mtpu resume`)")
+                    requeue_budget_spent = True
+                trial.transition(res.status)
+                experiment.ledger.update_trial(
+                    trial, expected_status="reserved", expected_worker=worker_id
                 )
-        else:
-            if res.requeue:
-                # the executor flagged a retry, but the shared budget is
-                # spent — the stored outcome must say what actually
-                # happens (nothing, until a human resumes it)
-                res.note += (" (requeue budget exhausted — "
-                             "see `mtpu resume`)")
-                requeue_budget_spent = True
-            trial.transition(res.status)
-            experiment.ledger.update_trial(
-                trial, expected_status="reserved", expected_worker=worker_id
+                _settle(res.status)
+                stats.broken += res.status == "broken"
+                stats.interrupted += res.status == "interrupted"
+                if res.status == "broken":
+                    # the note carries the evidence (exit code + stderr tail);
+                    # at INFO it is invisible under the default CLI level and
+                    # the eventual max_broken ERROR reads as evidence-free
+                    last_broken_note = res.note
+                    if res.note:
+                        log.warning(
+                            "%s: trial %s broken: %s",
+                            worker_id, trial.id[:8], res.note)
+                elif res.note:
+                    log.info("trial %s %s: %s", trial.id[:8], res.status, res.note)
+            stats.events.append(
+                {
+                    "trial": trial.id,
+                    "status": res.status,
+                    "runtime_s": round(time.time() - t0, 4),
+                    "note": res.note,
+                }
             )
-            _settle(res.status)
-            stats.broken += res.status == "broken"
-            stats.interrupted += res.status == "interrupted"
-            if res.status == "broken":
-                # the note carries the evidence (exit code + stderr tail);
-                # at INFO it is invisible under the default CLI level and
-                # the eventual max_broken ERROR reads as evidence-free
-                last_broken_note = res.note
-                if res.note:
-                    log.warning(
-                        "%s: trial %s broken: %s",
-                        worker_id, trial.id[:8], res.note)
-            elif res.note:
-                log.info("trial %s %s: %s", trial.id[:8], res.status, res.note)
-        stats.events.append(
-            {
-                "trial": trial.id,
-                "status": res.status,
-                "runtime_s": round(time.time() - t0, 4),
-                "note": res.note,
-            }
-        )
-        if requeue_budget_spent:
-            # the backend stayed dead through every park + retry this
-            # trial was entitled to (~3 park budgets of wall clock) and
-            # the final attempt just went terminal — continuing would
-            # have the producer mint replacement trials forever, each
-            # doomed to the same grind. Stop THIS worker; the interrupted
-            # trials resume with `mtpu resume` once the device returns.
-            # (A terminal-interrupted trial satisfies no stop condition:
-            # it is neither completed nor broken.) NOTE: this must key on
-            # the budget-exhausted branch having actually run, not on the
-            # stored counter — right after the LAST successful requeue
-            # the counter already reads max_requeues, and breaking there
-            # would strand the trial in 'new' instead of interrupted.
-            log.error(
-                "%s: TPU backend did not recover within trial %s's requeue "
-                "budget — stopping worker (state preserved; `mtpu resume` "
-                "when the device returns)", worker_id, trial.id[:8],
-            )
-            break
+            if requeue_budget_spent:
+                # the backend stayed dead through every park + retry this
+                # trial was entitled to (~3 park budgets of wall clock) and
+                # the final attempt just went terminal — continuing would
+                # have the producer mint replacement trials forever, each
+                # doomed to the same grind. Stop THIS worker; the interrupted
+                # trials resume with `mtpu resume` once the device returns.
+                # (A terminal-interrupted trial satisfies no stop condition:
+                # it is neither completed nor broken.) NOTE: this must key on
+                # the budget-exhausted branch having actually run, not on the
+                # stored counter — right after the LAST successful requeue
+                # the counter already reads max_requeues, and breaking there
+                # would strand the trial in 'new' instead of interrupted.
+                log.error(
+                    "%s: TPU backend did not recover within trial %s's requeue "
+                    "budget — stopping worker (state preserved; `mtpu resume` "
+                    "when the device returns)", worker_id, trial.id[:8],
+                )
+                break
 
+    except BaseException:
+        # error exits (coordinator unavailable, executor blow-ups, the
+        # KeyboardInterrupt re-raise) still attempt the deferred push,
+        # best-effort: the flush must not mask the original failure
+        try:
+            _flush_pending()
+        except Exception:
+            log.warning(
+                "%s: deferred result push failed during error unwind "
+                "(the stale sweep will re-free the trial)", worker_id,
+            )
+        raise
     # a result the next cycle never got to carry (the loop exited first)
     # still must reach the ledger — the deferred push is an optimization,
     # never a correctness trade

@@ -895,3 +895,231 @@ class TestWorkerCycle:
             t.join(timeout=60)
         assert not errors
         assert all(t.status == "completed" for t in c.fetch("snap"))
+
+
+def _crash(server):
+    """Simulate kill -9: skip the shutdown snapshot so the WAL is the only
+    record of everything since the last (possibly absent) snapshot."""
+    server.snapshot_path = None
+    server.stop()
+
+
+class TestWALDurability:
+    """Write-ahead log: replay, compaction, torn tails, recovery grace."""
+
+    def test_wal_replays_without_snapshot(self, tmp_path):
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap) as s1:
+            c = _client(s1)
+            c.create_experiment({"name": "exp", "max_trials": 7})
+            for x in (1.0, 2.0, 3.0):
+                c.register(_trial(x))
+            got = c.reserve("exp", "w0")
+            got.transition("completed")
+            got.attach_results(
+                [{"name": "objective", "type": "objective", "value": 0.25}]
+            )
+            assert c.update_trial(got, expected_status="reserved")
+            c.set_signal("exp", got.id, "stop")
+            _crash(s1)
+        import os
+        assert not os.path.exists(snap)  # crash == no shutdown snapshot
+        assert os.path.getsize(snap + ".wal") > 0
+        with CoordServer(snapshot_path=snap) as s2:
+            c2 = _client(s2)
+            assert c2.load_experiment("exp")["max_trials"] == 7
+            assert c2.count("exp") == 3
+            assert c2.count("exp", status="completed") == 1
+            done = [t for t in c2.fetch("exp") if t.status == "completed"]
+            assert done[0].objective == 0.25
+            assert s2._signals.get(("exp", done[0].id)) == "stop"
+
+    def test_snapshot_compacts_wal(self, tmp_path):
+        import os
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap) as s:
+            c = _client(s)
+            c.create_experiment({"name": "exp"})
+            for x in range(5):
+                c.register(_trial(float(x)))
+            assert os.path.getsize(snap + ".wal") > 0
+            c.snapshot(snap)
+            # everything up to the snapshot's wal_seq is dropped from disk
+            assert os.path.getsize(snap + ".wal") == 0
+            assert json.load(open(snap))["wal_seq"] > 0
+        # clean stop: snapshot again + compact; restart from snapshot alone
+        with CoordServer(snapshot_path=snap) as s2:
+            assert _client(s2).count("exp") == 5
+
+    def test_torn_tail_truncated_and_acked_state_survives(self, tmp_path):
+        import os
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap) as s1:
+            c = _client(s1)
+            c.create_experiment({"name": "exp"})
+            c.register(_trial(1.0))
+            c.register(_trial(2.0))
+            _crash(s1)
+        wal = snap + ".wal"
+        good = os.path.getsize(wal)
+        with open(wal, "ab") as f:  # half-written record from a kill -9
+            f.write(b"deadbeef {\"op\": \"put_trial\", \"tru")
+        with CoordServer(snapshot_path=snap) as s2:
+            assert _client(s2).count("exp") == 2  # acked writes intact
+        # recovery physically truncated the torn tail, then compacted the
+        # replayed prefix into the post-recovery snapshot
+        assert os.path.getsize(wal) == 0
+        assert json.load(open(snap))["experiments"]
+
+    def test_recovery_refreshes_reserved_heartbeats(self, tmp_path):
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap) as s1:
+            c = _client(s1)
+            c.create_experiment({"name": "exp"})
+            c.register(_trial(1.0))
+            got = c.reserve("exp", "w0")
+            assert got is not None
+            _crash(s1)
+        time.sleep(0.3)  # downtime that must NOT count against the lease
+        with CoordServer(snapshot_path=snap) as s2:
+            (t,) = [t for t in _client(s2).fetch("exp")
+                    if t.status == "reserved"]
+            assert time.time() - t.heartbeat < 0.25
+
+    def test_bare_in_memory_server_has_no_wal(self, server):
+        assert server.wal_path is None
+        assert server._wal is None
+        r = _client(server)._call("ping")
+        assert r["durable"] is False
+
+
+class TestExactlyOnceAcrossRestart:
+    """A retry whose original ack died with the server is answered from the
+    journaled reply cache — never re-executed."""
+
+    def _raw(self, server, msg):
+        import socket as _socket
+        from metaopt_tpu.coord.protocol import recv_msg, send_msg
+        host, port = server.address
+        with _socket.create_connection((host, port)) as sk:
+            send_msg(sk, msg)
+            return recv_msg(sk)
+
+    def test_reserve_retry_replayed_from_journaled_reply(self, tmp_path):
+        snap = str(tmp_path / "snap.json")
+        req = {"op": "reserve", "req": "retry-1",
+               "args": {"experiment": "exp", "worker": "w0"}}
+        with CoordServer(snapshot_path=snap) as s1:
+            c = _client(s1)
+            c.create_experiment({"name": "exp"})
+            c.register(_trial(1.0))
+            c.register(_trial(2.0))
+            first = self._raw(s1, req)
+            assert first["ok"] and first["result"] is not None
+            _crash(s1)
+        with CoordServer(snapshot_path=snap) as s2:
+            second = self._raw(s2, req)
+            assert second == first  # same trial, from the journaled cache
+            assert _client(s2).count("exp", status="reserved") == 1
+
+    def test_worker_cycle_retry_replayed_from_journaled_reply(self, tmp_path):
+        snap = str(tmp_path / "snap.json")
+        req = {"op": "worker_cycle", "req": "cycle-retry-1",
+               "args": {"experiment": "exp", "worker": "w0",
+                        "produce": False}}
+        with CoordServer(snapshot_path=snap) as s1:
+            c = _client(s1)
+            c.create_experiment({
+                "name": "exp", "space": {"x": "uniform(0, 10)"},
+                "algorithm": {"random": {"seed": 0}}, "max_trials": 5,
+            })
+            c.register(_trial(1.0))
+            c.register(_trial(2.0))
+            first = self._raw(s1, req)
+            assert first["ok"] and first["result"]["trial"] is not None
+            _crash(s1)
+        with CoordServer(snapshot_path=snap) as s2:
+            second = self._raw(s2, req)
+            assert second == first
+            assert _client(s2).count("exp", status="reserved") == 1
+
+
+class TestRestoreMergeSemantics:
+    """Pin restore()'s conservative merge: it only registers trials MISSING
+    from the inner ledger and never advances an existing trial's status —
+    the live ledger (e.g. a shared FileLedger that outlived the snapshot)
+    is always at least as new as the snapshot."""
+
+    def test_restore_never_advances_existing_trial_status(self, tmp_path):
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap) as s1:
+            c = _client(s1)
+            c.create_experiment({"name": "exp", "max_trials": 5})
+            c.register(_trial(1.0))  # 'new' in the snapshot
+            c.register(_trial(2.0))
+        # live ledger where the same trial has SINCE completed
+        s2 = CoordServer()
+        s2.inner.create_experiment({"name": "exp", "max_trials": 5})
+        done = _trial(1.0)  # same params => same deterministic id
+        done.transition("reserved")
+        done.transition("completed")
+        s2.inner.register(done)
+        s2.restore(snap)
+        docs = {t.id: t for t in s2.inner.fetch("exp")}
+        assert len(docs) == 2  # missing trial registered, no duplicates
+        assert docs[done.id].status == "completed"  # never rolled back
+        assert docs[_trial(2.0).id].status == "new"
+
+
+class TestClientResumption:
+    def test_jitter_bounds_and_growth(self):
+        from metaopt_tpu.coord.client_backend import decorrelated_jitter
+        d = 0.0
+        seen = []
+        for _ in range(50):
+            d = decorrelated_jitter(d, base_s=0.05, cap_s=2.0)
+            assert 0.05 <= d <= 2.0
+            seen.append(d)
+        assert len(set(seen)) > 1  # jittered, not a fixed schedule
+
+    def test_reconnect_reasserts_reservation_after_restart(self, tmp_path):
+        snap = str(tmp_path / "snap.json")
+        s1 = CoordServer(snapshot_path=snap)
+        s1.start()
+        host, port = s1.address
+        c = CoordLedgerClient(host=host, port=port, reconnect_window_s=10.0)
+        c.create_experiment({"name": "exp"})
+        c.register(_trial(1.0))
+        got = c.reserve("exp", "w0")
+        assert got is not None
+        inc1 = c._incarnation
+        assert ("exp", got.id) in c._live
+        s1.stop()  # clean stop: snapshot + WAL compaction
+        # restart on the SAME port: reservation survives via snapshot,
+        # and the client re-asserts it on first reconnected call
+        s2 = CoordServer(host=host, port=port, snapshot_path=snap)
+        s2.start()
+        try:
+            assert c.count("exp", status="reserved") == 1
+            assert c._incarnation != inc1
+            assert c.heartbeat("exp", got.id, "w0") is True
+            got.transition("completed")
+            assert c.update_trial(got, expected_status="reserved")
+            assert ("exp", got.id) not in c._live
+        finally:
+            s2.stop()
+
+
+class TestPutTrialUpsert:
+    def test_put_trial_registers_then_overwrites(self):
+        led = MemoryLedger()
+        led.create_experiment({"name": "exp"})
+        t = _trial(1.0)
+        led.put_trial(t)
+        assert led.count("exp") == 1
+        t2 = _trial(1.0)
+        t2.transition("reserved")
+        t2.transition("completed")
+        led.put_trial(t2)  # same id: unconditional overwrite, no error
+        (doc,) = led.fetch("exp")
+        assert doc.status == "completed"
