@@ -179,16 +179,21 @@ class MOTPE(TPE):
 
     # -- persistence -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
-        # ONE lock acquisition around both snapshots (RLock nests): a
-        # concurrent observe() between them would serialize an F one row
-        # longer than X/y, and restoring that state crashes _sync_device
-        with self._kernel_lock:
+        # launch -> kernel, in TPE's documented order: super().state_dict
+        # takes BOTH locks (RLocks nest, so re-acquiring is free), and
+        # grabbing the kernel lock alone first AB-BA-deadlocks against the
+        # speculative-refill thread, which holds launch while waiting for
+        # kernel. ONE acquisition still spans both snapshots: a concurrent
+        # observe() between them would serialize an F one row longer than
+        # X/y, and restoring that state crashes _sync_device
+        with self._launch_lock, self._kernel_lock:
             s = super().state_dict()
             s["F"] = [list(f) for f in self._F]
         return s
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
-        with self._kernel_lock:
+        # launch -> kernel for the same reason as state_dict above
+        with self._launch_lock, self._kernel_lock:
             super().load_state_dict(state)
             self._F = [list(f) for f in state.get("F", [])]
             if self._F:

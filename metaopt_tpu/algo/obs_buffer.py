@@ -40,6 +40,7 @@ from metaopt_tpu.ops.tpe_math import pad_pow2
 _BULK_THRESHOLD = 64
 
 
+# mtpu: hotpath
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _append_row(X, y, row, val, n):
     """One-row append into donated buffers: O(d) H2D, in-place update."""

@@ -1,0 +1,52 @@
+"""AST-based static analysis for repo-specific invariants (``mtpu lint``).
+
+PRs 1-3 grew three classes of invariants that nothing enforced mechanically:
+
+* a sharded lock hierarchy in the coordinator (``_exp_locks`` under
+  ``_exp_locks_guard``, WAL buffer locks, reply-cache guards) with a
+  documented acquisition order,
+* donated-buffer JAX kernels (``obs_buffer`` appends) and trace-time
+  hygiene rules (no ambient-context reads inside ``jax.jit`` bodies —
+  the ``active_mesh()`` class of bug from ADVICE round 5),
+* a durability contract: every acked mutation journals to the WAL before
+  its reply leaves the sender thread.
+
+Each was hand-verified in review. This package checks them on every PR,
+in the spirit of kernel lockdep (lock-order validation) and
+FindBugs-style project-specific bug patterns.
+
+Checker families and rule ids:
+
+=========  ==============================================================
+MTL001     lock-order inversion (cycle in the lock-acquisition graph)
+MTL002     blocking call while holding a no-block lock
+MTL003     write to a registered guarded attribute outside its guard
+MTL004     call into a ``holds(X)``-annotated function without X held
+MTJ001     use of a donated buffer after the donating jit call
+MTJ002     ambient mutable context read inside a jit-traced function
+MTJ003     host-sync call inside a ``# mtpu: hotpath`` function
+MTJ004     non-static / non-hashable value bound to ``static_argnames``
+MTD001     journaled op whose dispatch branch reaches no journal call
+MTD002     registry drift between protocol registry and server op sets
+MTD003     reply-journaled op whose handler never journals its reply
+=========  ==============================================================
+
+Findings carry ``file:line`` + rule id. A checked-in baseline
+(``analysis/baseline.json``) grandfathers pre-existing findings so the
+CI gate (``tests/unit/test_lint_clean.py``) fails only on regressions.
+
+Source pragmas (comments)::
+
+    # mtpu: hotpath             -- function must never host-sync (MTJ003)
+    # mtpu: holds(_lock)        -- caller holds _lock (MTL003/MTL004)
+    # mtpu: lint-ok MTL003 why  -- suppress one rule on this line
+"""
+
+from metaopt_tpu.analysis.core import Finding, LintModule, load_paths
+from metaopt_tpu.analysis.registry import LintConfig, default_config
+from metaopt_tpu.analysis.runner import run_lint
+
+__all__ = [
+    "Finding", "LintModule", "load_paths",
+    "LintConfig", "default_config", "run_lint",
+]

@@ -1,0 +1,337 @@
+"""JAX hygiene checkers (MTJ001-MTJ004).
+
+**Traced set.** A function is jit-traced when it is (a) decorated with
+``jax.jit`` / ``functools.partial(jax.jit, ...)``, (b) passed to a
+``jax.jit(...)`` call anywhere in the scanned set, or (c) defined inside
+a factory whose *result* is jitted (``jax.jit(make_train_step(...))``
+marks the closures ``make_train_step`` defines — the repo's train-step
+builder idiom). The set then closes transitively over bare-name calls,
+so ``train_step -> loss_fn -> blocked_xent_enabled`` is all traced.
+
+* **MTJ001** — a buffer passed in a donated position (``donate_argnums``)
+  is read later in the same function without being reassigned first.
+  Reassignment in the statement that makes the call (``x, y = f(x, y)``)
+  is the sanctioned idiom and is clean.
+* **MTJ002** — a traced function calls an ambient mutable-context getter
+  (``active_mesh()``, ``os.environ.get``, ``time.time`` ...): the value
+  is frozen at trace time and silently stale on cache hits — the
+  ADVICE round-5 ``blocked_xent_enabled()`` bug class.
+* **MTJ003** — a host-sync call (``np.asarray``, ``.item()``,
+  ``.block_until_ready()``, ``float()`` ...) inside a function marked hot
+  via the ``# mtpu: hotpath`` pragma or the config registry.
+* **MTJ004** — ``static_argnames`` declarations that are not literal
+  strings, or call sites binding an unhashable literal (list/dict/set)
+  to a declared-static parameter.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from metaopt_tpu.analysis.core import (
+    Finding, LintModule, dotted_name, is_hashable_literal)
+from metaopt_tpu.analysis.registry import LintConfig
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    dn = dotted_name(node)
+    return dn is not None and (dn == "jit" or dn.endswith(".jit"))
+
+
+@dataclass
+class _JitSpec:
+    donate: Tuple[int, ...] = ()
+    static_names: Tuple[str, ...] = ()
+    bad_static_decl: Optional[int] = None  # line of a non-literal decl
+
+
+def _jit_kwargs(call: ast.Call) -> _JitSpec:
+    spec = _JitSpec()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                v = ast.literal_eval(kw.value)
+                spec.donate = tuple(v) if isinstance(
+                    v, (tuple, list)) else (int(v),)
+            except (ValueError, TypeError, SyntaxError):
+                pass
+        elif kw.arg in ("static_argnames", "static_argnums"):
+            try:
+                v = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                spec.bad_static_decl = kw.value.lineno
+                continue
+            if kw.arg == "static_argnames":
+                names = (v,) if isinstance(v, str) else tuple(v)
+                if all(isinstance(n, str) for n in names):
+                    spec.static_names = names
+                else:
+                    spec.bad_static_decl = kw.value.lineno
+    return spec
+
+
+class JaxChecker:
+    def __init__(self, modules: List[LintModule], cfg: LintConfig) -> None:
+        self.modules = modules
+        self.cfg = cfg
+        # function-name -> (module, def node); bare-name call graph
+        self.defs: Dict[str, List[Tuple[LintModule, ast.FunctionDef]]] = {}
+        for mod in modules:
+            for fn, _cls in mod.functions():
+                self.defs.setdefault(fn.name, []).append((mod, fn))
+        #: name -> _JitSpec for functions jitted with donation/statics
+        self.jitted: Dict[str, _JitSpec] = {}
+        self.traced: Set[str] = set()
+        self._find_jitted()
+        self._close_traced()
+
+    # -- traced-set construction ------------------------------------------
+    def _find_jitted(self) -> None:
+        for mod in self.modules:
+            for fn, _cls in mod.functions():
+                for dec in fn.decorator_list:
+                    spec = self._spec_of(dec)
+                    if spec is not None:
+                        self.jitted.setdefault(fn.name, spec)
+                        self.traced.add(fn.name)
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and _is_jit_name(node.func) and node.args):
+                    continue
+                spec = _jit_kwargs(node)
+                tgt = node.args[0]
+                if isinstance(tgt, ast.Name):
+                    self.traced.add(tgt.id)
+                    name = tgt.id
+                elif isinstance(tgt, ast.Call) and isinstance(
+                        tgt.func, ast.Name):
+                    # jax.jit(make_train_step(...)): the factory's nested
+                    # defs are the traced bodies
+                    name = None
+                    for fmod, fdef in self.defs.get(tgt.func.id, ()):
+                        for sub in ast.walk(fdef):
+                            if isinstance(sub, ast.FunctionDef
+                                          ) and sub is not fdef:
+                                self.traced.add(sub.name)
+                                name = sub.name
+                else:
+                    continue
+                # bind the spec to the jitted value's assigned name too,
+                # so call sites through that name are checked
+                if name:
+                    self.jitted.setdefault(name, spec)
+                parent = mod.parents.get(node)
+                if isinstance(parent, ast.Assign) and len(
+                        parent.targets) == 1 and isinstance(
+                        parent.targets[0], ast.Name):
+                    self.jitted.setdefault(parent.targets[0].id, spec)
+
+    def _spec_of(self, dec: ast.AST) -> Optional[_JitSpec]:
+        """A decorator that jits: ``@jax.jit`` or
+        ``@functools.partial(jax.jit, ...)`` (or a jit(...) call)."""
+        if _is_jit_name(dec):
+            return _JitSpec()
+        if isinstance(dec, ast.Call):
+            if _is_jit_name(dec.func):
+                return _jit_kwargs(dec)
+            dn = dotted_name(dec.func)
+            if dn and dn.split(".")[-1] == "partial" and dec.args and \
+                    _is_jit_name(dec.args[0]):
+                return _jit_kwargs(dec)
+        return None
+
+    def _close_traced(self) -> None:
+        work = list(self.traced)
+        while work:
+            name = work.pop()
+            for mod, fn in self.defs.get(name, ()):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        dn = dotted_name(node.func)
+                        if dn and "." not in dn and dn in self.defs \
+                                and dn not in self.traced:
+                            self.traced.add(dn)
+                            work.append(dn)
+
+    # -- findings ----------------------------------------------------------
+    def run(self) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in self.modules:
+            for fn, cls in mod.functions():
+                qn = mod.qualname(fn)
+                if fn.name in self.traced:
+                    out.extend(self._ambient(mod, fn, qn))
+                if self._is_hot(mod, fn, cls):
+                    out.extend(self._host_sync(mod, fn, qn))
+                out.extend(self._donation_sites(mod, fn, qn))
+                out.extend(self._static_args(mod, fn, qn))
+        for name, spec in sorted(self.jitted.items()):
+            if spec.bad_static_decl is not None:
+                for mod, fn in self.defs.get(name, ()):
+                    out.append(Finding(
+                        "MTJ004", mod.relpath, spec.bad_static_decl,
+                        f"static_argnames of {name} is not a literal "
+                        f"str/tuple of str", symbol=name,
+                        detail=f"{name}|decl"))
+        return [f for f in out if not self._suppressed(f)]
+
+    def _is_hot(self, mod: LintModule, fn: ast.FunctionDef,
+                cls) -> bool:
+        if mod.is_hotpath(fn):
+            return True
+        qn = f"{cls.name}.{fn.name}" if cls is not None else fn.name
+        reg = self.cfg.hotpath_registry
+        return fn.name in reg or qn in reg
+
+    def _ambient(self, mod: LintModule, fn: ast.FunctionDef,
+                 qn: str) -> List[Finding]:
+        out = []
+        for node in ast.walk(fn):
+            dn: Optional[str] = None
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+            elif isinstance(node, ast.Subscript):
+                base = dotted_name(node.value)
+                if base and base.split(".")[-1] == "environ":
+                    dn = base + ".get"
+            if dn is None:
+                continue
+            for pat in self.cfg.ambient_getters:
+                if dn == pat or dn.endswith("." + pat):
+                    out.append(Finding(
+                        "MTJ002", mod.relpath, node.lineno,
+                        f"{qn} is jit-traced but reads ambient context "
+                        f"via {dn}() — the value freezes at trace time",
+                        symbol=qn, detail=pat))
+                    break
+        return out
+
+    def _host_sync(self, mod: LintModule, fn: ast.FunctionDef,
+                   qn: str) -> List[Finding]:
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            last = dn.split(".")[-1]
+            for pat in self.cfg.host_sync_calls:
+                hit = (dn == pat or dn.endswith("." + pat)) if "." in pat \
+                    else last == pat
+                if not hit:
+                    continue
+                if last in ("float", "int", "bool") and (
+                        not node.args
+                        or isinstance(node.args[0], ast.Constant)):
+                    continue
+                out.append(Finding(
+                    "MTJ003", mod.relpath, node.lineno,
+                    f"host-sync call {dn}() inside hotpath {qn}",
+                    symbol=qn, detail=pat))
+                break
+        return out
+
+    # -- donation ----------------------------------------------------------
+    def _donation_sites(self, mod: LintModule, fn: ast.FunctionDef,
+                        qn: str) -> List[Finding]:
+        """Linear scan of ``fn``'s statements: after a call to a
+        donated-jit function, a donated argument read again before being
+        reassigned is MTJ001."""
+        out = []
+        stmts = list(ast.walk(fn))
+        calls: List[Tuple[ast.Call, _JitSpec, Set[str]]] = []
+        for node in stmts:
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            spec = self.jitted.get(dn.split(".")[-1])
+            if spec is None or not spec.donate:
+                continue
+            donated: Set[str] = set()
+            for idx in spec.donate:
+                if idx < len(node.args):
+                    adn = dotted_name(node.args[idx])
+                    if adn:
+                        donated.add(adn)
+            if donated:
+                calls.append((node, spec, donated))
+        for call, spec, donated in calls:
+            parent = mod.parents.get(call)
+            reassigned: Set[str] = set()
+            while parent is not None and not isinstance(
+                    parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(parent, ast.Assign):
+                    for t in parent.targets:
+                        reassigned |= self._target_names(t)
+                parent = mod.parents.get(parent)
+            live = donated - reassigned
+            if not live:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Name, ast.Attribute)) \
+                        or node.lineno <= call.lineno:
+                    continue
+                dn = dotted_name(node)
+                if dn in live and isinstance(
+                        getattr(node, "ctx", None), ast.Load):
+                    out.append(Finding(
+                        "MTJ001", mod.relpath, node.lineno,
+                        f"{dn} was donated at line {call.lineno} and is "
+                        f"read again without reassignment "
+                        f"(use-after-donation)", symbol=qn, detail=dn))
+                    live.discard(dn)
+                elif dn in live and isinstance(
+                        getattr(node, "ctx", None), ast.Store):
+                    live.discard(dn)
+        return out
+
+    def _target_names(self, tgt: ast.AST) -> Set[str]:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            out: Set[str] = set()
+            for e in tgt.elts:
+                out |= self._target_names(e)
+            return out
+        dn = dotted_name(tgt)
+        return {dn} if dn else set()
+
+    # -- static_argnames at call sites -------------------------------------
+    def _static_args(self, mod: LintModule, fn: ast.FunctionDef,
+                     qn: str) -> List[Finding]:
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            spec = self.jitted.get(dn.split(".")[-1])
+            if spec is None or not spec.static_names:
+                continue
+            for kw in node.keywords:
+                if kw.arg in spec.static_names and isinstance(
+                        kw.value, _UNHASHABLE):
+                    out.append(Finding(
+                        "MTJ004", mod.relpath, kw.value.lineno,
+                        f"unhashable literal bound to static arg "
+                        f"{kw.arg!r} of {dn}", symbol=qn,
+                        detail=f"{dn.split('.')[-1]}|{kw.arg}"))
+        return out
+
+    def _suppressed(self, f: Finding) -> bool:
+        for mod in self.modules:
+            if mod.relpath == f.file:
+                return mod.suppressed(f.line, f.rule)
+        return False
+
+
+def check_jax(modules: List[LintModule], cfg: LintConfig
+              ) -> List[Finding]:
+    return JaxChecker(modules, cfg).run()

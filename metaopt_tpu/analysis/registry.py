@@ -1,0 +1,246 @@
+"""Declared invariants the checkers enforce — the repo's "lockdep map".
+
+Everything here is *declaration*, not detection: which attribute is
+guarded by which lock, which locks must never be held across blocking
+calls, which dotted names count as blocking / ambient / host-sync, and
+how the durability registry in ``coord/protocol.py`` maps onto the
+server's op sets. Tests build small configs of the same shape for their
+fixture modules, so the checkers stay config-driven and hermetic.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from metaopt_tpu.analysis.core import LintModule
+
+#: pseudo-lock for the server's per-experiment RLock family — every
+#: ``_exp_lock(name)`` / ``_op_lock(op, a)`` result is one node, since
+#: ordering hazards are against the family, not one instance
+EXP_LOCK = "EXP"
+
+
+@dataclass
+class LintConfig:
+    """Knobs + declarations for one lint run."""
+
+    # -- lock discipline ---------------------------------------------------
+    #: {ClassName: {attr_name}} — attributes that ARE locks; ``with
+    #: self.<attr>:`` acquires node "ClassName.<attr>". Classes not listed
+    #: fall back to a name heuristic (suffix lock/guard/cv/mutex).
+    lock_attrs: Dict[str, Set[str]] = field(default_factory=dict)
+    #: {method_name: (returned_lock_node, [locks taken inside the call])}
+    #: for lock *factories*: ``with self._exp_lock(n):`` acquires EXP and
+    #: briefly takes _exp_locks_guard internally.
+    lock_factories: Dict[str, Tuple[str, List[str]]] = field(
+        default_factory=dict)
+    #: lock nodes that must never be held across a blocking call
+    no_block_locks: Set[str] = field(default_factory=set)
+    #: dotted-name suffixes that count as blocking (matched against the
+    #: call's dotted name tail)
+    blocking_calls: Set[str] = field(default_factory=lambda: {
+        "os.fsync", "fsync_dir", "time.sleep", "sleep",
+        "sendall", "recv", "recv_into", "accept", "connect",
+        "recv_msg", "send_msg", "send_payload",
+        "subprocess.run", "subprocess.check_call",
+        "subprocess.check_output", "communicate",
+    })
+    #: {ClassName: {attr: guard_lock_node}} — shared state and its guard.
+    #: Writes (assign / augassign / del / mutating method call) outside a
+    #: ``with <guard>`` block (or a ``holds(<guard>)`` pragma) are MTL003.
+    guarded_attrs: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    #: methods where unguarded writes are allowed (single-threaded phases)
+    init_methods: Set[str] = field(
+        default_factory=lambda: {"__init__", "__new__"})
+    #: receiver-name roles for cross-class call resolution:
+    #: "proxy" = the server's sharded-ledger proxy (mutators acquire EXP
+    #: and journal to the WAL buffer), "wal" = WriteAheadLog, "backend" =
+    #: the in-memory ledger backend class.
+    receiver_roles: Dict[str, str] = field(default_factory=dict)
+    #: class names backing each role (resolution targets)
+    wal_class: str = "WriteAheadLog"
+    backend_class: str = "MemoryLedger"
+    #: ledger proxy method sets (mirror _ShardedLedger; overridable)
+    proxy_lock_free: FrozenSet[str] = frozenset({
+        "get", "fetch", "count", "fetch_completed_since",
+        "load_experiment", "list_experiments", "export_docs",
+    })
+    proxy_mutators: FrozenSet[str] = frozenset({
+        "create_experiment", "update_experiment", "delete_experiment",
+        "register", "reserve", "update_trial", "release_stale",
+    })
+    #: classes the bare-name fallback must never resolve into — the RPC
+    #: client mirrors the LedgerBackend API by design, and resolving a
+    #: server-internal backend call to the client's socket methods would
+    #: manufacture phantom blocking edges
+    no_fallback_classes: Set[str] = field(default_factory=set)
+    #: container/stdlib method names never resolved to scanned functions
+    #: (avoids ``self._pending.append`` aliasing WriteAheadLog.append)
+    never_resolve: Set[str] = field(default_factory=lambda: {
+        "append", "add", "get", "pop", "popleft", "update", "setdefault",
+        "extend", "remove", "discard", "clear", "items", "keys",
+        "values", "join", "split", "strip", "put", "get_nowait",
+        "encode", "decode", "close", "copy", "sort", "insert", "count",
+        "wait", "notify", "notify_all", "acquire", "release", "set",
+        "is_set", "todict", "to_dict", "from_dict", "write", "read",
+        "flush", "fileno",
+    })
+
+    # -- JAX hygiene -------------------------------------------------------
+    #: dotted-name tails that read ambient mutable context (MTJ002 inside
+    #: jit-traced code)
+    ambient_getters: Set[str] = field(default_factory=lambda: {
+        "active_mesh", "os.environ.get", "os.getenv", "environ.get",
+        "time.time", "time.monotonic", "datetime.now", "faults.fire",
+    })
+    #: dotted-name tails that synchronize device->host (MTJ003 inside
+    #: ``# mtpu: hotpath`` functions)
+    host_sync_calls: Set[str] = field(default_factory=lambda: {
+        "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+        "jax.device_get", "device_get", "block_until_ready", "item",
+        "float", "int", "bool",
+    })
+    #: functions treated as hot even without a pragma ("Class.fn" or "fn")
+    hotpath_registry: Set[str] = field(default_factory=set)
+
+    # -- durability contract ----------------------------------------------
+    #: ops whose dispatch branch must reach a journal call (None = read
+    #: the registry from the scanned protocol module)
+    journaled_ops: Optional[FrozenSet[str]] = None
+    reply_journaled_ops: Optional[FrozenSet[str]] = None
+    nested_journaled_ops: Optional[FrozenSet[str]] = None
+    #: module basename holding the registry declarations
+    protocol_module: str = "protocol.py"
+    #: dispatch/handler structure in the server class
+    dispatch_function: str = "_dispatch"
+    dispatch_op_var: str = "op"
+    journal_call_names: Set[str] = field(default_factory=lambda: {
+        "_journal_mutation", "_journal_reply", "append",
+    })
+    journal_receivers: Set[str] = field(default_factory=lambda: {
+        "_wal", "wal",
+    })
+
+
+def registry_frozensets(mod: LintModule, names: Set[str]
+                        ) -> Dict[str, FrozenSet[str]]:
+    """Extract ``NAME = frozenset({...})`` string-set declarations (module
+    or class level) from a parsed module — used to read the protocol
+    registry and the server's op sets without importing anything."""
+    out: Dict[str, FrozenSet[str]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name) or tgt.id not in names:
+            continue
+        try:
+            val = ast.literal_eval(ast.Expression(body=_strip_frozenset(
+                node.value)))
+        except (ValueError, SyntaxError):
+            continue
+        if isinstance(val, (set, frozenset, tuple, list)) and all(
+                isinstance(v, str) for v in val):
+            out[tgt.id] = frozenset(val)
+    return out
+
+
+def _strip_frozenset(node: ast.AST) -> ast.AST:
+    """``frozenset({...})`` / ``frozenset((...))`` -> the inner literal."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "frozenset" and node.args):
+        return node.args[0]
+    return node
+
+
+def default_config() -> LintConfig:
+    """The checked-in declarations for this repository.
+
+    Lock nodes are "ClassName.attr" (so ``MemoryLedger._lock`` and the
+    server's global ``_lock`` stay distinct) plus the EXP pseudo-node for
+    the per-experiment RLock family.
+
+    Deliberately NOT in ``no_block_locks``:
+
+    * ``CoordServer._snap_lock`` — exists to serialize snapshot file
+      writes; fsync under it is its whole job.
+    * ``CoordLedgerClient._lock`` — serializes RPCs on the shared socket;
+      send/recv under it is the design.
+    * ``WriteAheadLog._cv`` — a Condition; ``wait`` releases it, and the
+      group-commit leader does its I/O under the ``_syncing`` flag, not
+      under the cv.
+    """
+    cfg = LintConfig()
+    cfg.lock_attrs = {
+        "CoordServer": {
+            "_lock", "_exp_locks_guard", "_snap_lock", "_sig_lock",
+            "_replies_lock", "_inflight_lock", "_enc_lock",
+            "_producers_guard",
+        },
+        "WriteAheadLog": {"_buf_lock", "_cv"},
+        "CoordLedgerClient": {"_lock", "_caps_lock", "_live_lock"},
+        "MemoryLedger": {"_lock"},
+        "_ProduceCoalescer": {"_guard"},
+    }
+    cfg.lock_factories = {
+        "_exp_lock": (EXP_LOCK, ["CoordServer._exp_locks_guard"]),
+        "_op_lock": (EXP_LOCK, ["CoordServer._exp_locks_guard"]),
+    }
+    cfg.no_block_locks = {
+        EXP_LOCK,
+        "CoordServer._lock",
+        "CoordServer._exp_locks_guard",
+        "CoordServer._sig_lock",
+        "CoordServer._replies_lock",
+        "CoordServer._inflight_lock",
+        "CoordServer._enc_lock",
+        "CoordServer._producers_guard",
+        "WriteAheadLog._buf_lock",
+        "MemoryLedger._lock",
+        "CoordLedgerClient._caps_lock",
+        "CoordLedgerClient._live_lock",
+    }
+    cfg.guarded_attrs = {
+        "CoordServer": {
+            # reply cache (exactly-once): request-id -> reply
+            "_replies": "CoordServer._replies_lock",
+            "_exp_locks": "CoordServer._exp_locks_guard",
+            "_signals": "CoordServer._sig_lock",
+            "_inflight": "CoordServer._inflight_lock",
+            "_enc_cache": "CoordServer._enc_lock",
+            "_enc_hits": "CoordServer._enc_lock",
+            "_producers": "CoordServer._producers_guard",
+            "_coalescers": "CoordServer._producers_guard",
+        },
+        "WriteAheadLog": {
+            "_pending": "WriteAheadLog._buf_lock",
+            "_next_seq": "WriteAheadLog._buf_lock",
+            "_appended": "WriteAheadLog._buf_lock",
+            "_durable": "WriteAheadLog._cv",
+            "_syncing": "WriteAheadLog._cv",
+        },
+        "CoordLedgerClient": {
+            "_caps": "CoordLedgerClient._caps_lock",
+            "_incarnation": "CoordLedgerClient._caps_lock",
+            "_live": "CoordLedgerClient._live_lock",
+        },
+        "MemoryLedger": {
+            # ledger dicts + the O(1) status-count index
+            "_experiments": "MemoryLedger._lock",
+            "_trials": "MemoryLedger._lock",
+            "_status_ids": "MemoryLedger._lock",
+            "_new_heap": "MemoryLedger._lock",
+            "_completed_log": "MemoryLedger._lock",
+            "_exp_gen": "MemoryLedger._lock",
+        },
+    }
+    cfg.receiver_roles = {
+        "ledger": "proxy", "_ledger": "proxy",
+        "_wal": "wal", "wal": "wal",
+        "_inner": "backend", "inner": "backend",
+    }
+    cfg.no_fallback_classes = {"CoordLedgerClient"}
+    cfg.hotpath_registry = set()
+    return cfg

@@ -1,0 +1,138 @@
+"""Lint driver: run all checker families, diff against the baseline.
+
+The baseline (``metaopt_tpu/analysis/baseline.json``) grandfathers
+pre-existing findings by *fingerprint* — ``rule::file::symbol::detail``,
+deliberately excluding line numbers so unrelated edits don't churn it.
+The count per fingerprint is kept: introducing a SECOND instance of a
+grandfathered pattern in the same function is still a regression.
+
+Exit codes: 0 clean, 1 new findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from metaopt_tpu.analysis.core import Finding, load_paths
+from metaopt_tpu.analysis.durability import check_durability
+from metaopt_tpu.analysis.jax_hygiene import check_jax
+from metaopt_tpu.analysis.locks import check_locks
+from metaopt_tpu.analysis.registry import LintConfig, default_config
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+#: fingerprints embed paths relative to the REPO root (the directory
+#: holding the metaopt_tpu package), never the caller's cwd — the
+#: checked-in baseline must match from anywhere `mtpu lint` is invoked
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_lint(paths: Sequence[str], cfg: Optional[LintConfig] = None,
+             root: Optional[str] = None) -> List[Finding]:
+    cfg = cfg or default_config()
+    modules = load_paths(paths, root=root)
+    findings: List[Finding] = []
+    findings += check_locks(modules, cfg)
+    findings += check_jax(modules, cfg)
+    findings += check_durability(modules, cfg)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.detail))
+    return findings
+
+
+def load_baseline(path: str) -> Counter:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return Counter()
+    return Counter({e["fingerprint"]: int(e.get("count", 1))
+                    for e in data.get("findings", [])})
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    counts = Counter(f.fingerprint() for f in findings)
+    lines: Dict[str, int] = {}
+    msgs: Dict[str, str] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        lines.setdefault(fp, f.line)
+        msgs.setdefault(fp, f.message)
+    entries = [{"fingerprint": fp, "count": n,
+                "line_at_capture": lines[fp], "message": msgs[fp]}
+               for fp, n in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def diff_baseline(findings: Sequence[Finding], baseline: Counter
+                  ) -> List[Finding]:
+    """Findings beyond the grandfathered per-fingerprint counts."""
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget[fp] > 0:
+            budget[fp] -= 1
+        else:
+            new.append(f)
+    return new
+
+
+def lint_main(argv: Optional[Sequence[str]] = None,
+              cfg: Optional[LintConfig] = None) -> int:
+    """CLI body shared by ``mtpu lint`` and the tier-1 gate test."""
+    ap = argparse.ArgumentParser(
+        prog="mtpu lint",
+        description="repo-invariant static analysis (lock discipline, "
+                    "JAX hygiene, WAL durability contract)")
+    ap.add_argument("paths", nargs="*", default=[PKG_DIR],
+                    help="files/directories to scan (default: the "
+                         "metaopt_tpu package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="grandfathered-findings file (default: the "
+                         "checked-in analysis/baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this run's findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    try:
+        findings = run_lint(args.paths, cfg=cfg, root=REPO_ROOT)
+    except (OSError, SyntaxError) as e:
+        print(f"mtpu lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"baseline updated: {len(findings)} finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    baseline = Counter() if args.no_baseline else load_baseline(
+        args.baseline)
+    new = diff_baseline(findings, baseline)
+    grandfathered = len(findings) - len(new)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "grandfathered": grandfathered,
+            "total": len(findings),
+        }, indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        note = (f"{len(new)} new finding(s), "
+                f"{grandfathered} grandfathered by baseline")
+        print(("FAIL: " if new else "clean: ") + note)
+    return 1 if new else 0

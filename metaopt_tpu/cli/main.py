@@ -281,6 +281,23 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--event-log", dest="event_log_path", default=None,
                      help="JSONL event log path")
 
+    lint = sub.add_parser(
+        "lint",
+        help="repo-invariant static analysis (lock discipline, JAX "
+             "hygiene, WAL durability contract)",
+    )
+    lint.add_argument("paths", nargs="*", default=[],
+                      help="files/directories to scan (default: the "
+                           "metaopt_tpu package, from any cwd)")
+    lint.add_argument("--baseline", default=None,
+                      help="grandfathered-findings file (default: the "
+                           "checked-in analysis/baseline.json)")
+    lint.add_argument("--update-baseline", action="store_true")
+    lint.add_argument("--no-baseline", action="store_true",
+                      help="report every finding, ignore the baseline")
+    lint.add_argument("--format", choices=("text", "json"),
+                      default="text", dest="lint_format")
+
     return p
 
 
@@ -1641,8 +1658,23 @@ def _cmd_benchmark(args, cfg) -> int:
     return 0
 
 
+def _cmd_lint(args: argparse.Namespace, cfg: Dict[str, Any]) -> int:
+    from metaopt_tpu.analysis.runner import lint_main
+
+    argv: List[str] = list(args.paths or [])
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.update_baseline:
+        argv.append("--update-baseline")
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    argv += ["--format", args.lint_format]
+    return lint_main(argv)
+
+
 _COMMANDS = {
     "hunt": _cmd_hunt,
+    "lint": _cmd_lint,
     "benchmark": _cmd_benchmark,
     "init-only": _cmd_init_only,
     "insert": _cmd_insert,
@@ -1670,6 +1702,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         ):
             args.assignments = list(getattr(args, "assignments", None) or [])
             args.assignments += extras
+        elif getattr(args, "command", None) == "lint" and all(
+            not e.startswith("-") for e in extras
+        ):
+            # same 3.10 nargs="*" quirk for `lint --format json PATH`
+            args.paths = list(getattr(args, "paths", None) or []) + extras
         else:
             parser.error("unrecognized arguments: %s" % " ".join(extras))
     level = [logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]

@@ -50,6 +50,25 @@ from typing import Any, Dict, Optional
 _HDR = struct.Struct(">I")
 MAX_MSG_BYTES = 64 * 1024 * 1024  # a fetch of ~100k trial docs fits well under
 
+#: Durability-contract registry, enforced statically by ``mtpu lint``
+#: (metaopt_tpu/analysis/durability.py). Ops listed here mutate ledger or
+#: signal state and their ``_dispatch`` branch MUST reach a journal point
+#: (a sharded-ledger mutator call or a direct ``wal.append``) before the
+#: reply is enqueued; all three sets must stay subsets of the server's
+#: ``_DURABLE_OPS`` so the reply actually waits on the fsync barrier.
+#: Adding a mutating op without declaring it here fails the lint gate.
+JOURNALED_OPS = frozenset({
+    "create_experiment", "update_experiment", "delete_experiment",
+    "register", "reserve", "update_trial", "release_stale", "set_signal",
+})
+#: ops journaled via their cached reply record: the journaled reply
+#: embeds the resulting docs and doubles as their redo (see
+#: ``CoordServer._journal_reply`` / ``_apply_wal_record``)
+REPLY_JOURNALED_OPS = frozenset({"worker_cycle"})
+#: ops that mutate only through nested ledger calls, each of which
+#: journals itself inside the sharded proxy
+NESTED_JOURNALED_OPS = frozenset({"produce"})
+
 
 class ProtocolError(RuntimeError):
     pass

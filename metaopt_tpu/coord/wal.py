@@ -147,8 +147,10 @@ class WriteAheadLog:
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
         self._f = open(self.path, "ab")
-        self._next_seq = max(1, next_seq)
-        self._appended = self._durable = self._next_seq - 1
+        # single-threaded: open() runs before any serving thread exists
+        self._next_seq = max(1, next_seq)  # mtpu: lint-ok MTL003 pre-serving
+        self._appended = self._durable = (  # mtpu: lint-ok MTL003 pre-serving
+            self._next_seq - 1)
         return self
 
     def close(self) -> None:
@@ -163,7 +165,12 @@ class WriteAheadLog:
             if self._f is not None:
                 try:
                     self._write_batch(batch)
-                    self._durable = max(self._durable, upto)
+                    # publish under the cv like sync()/compact(): a racing
+                    # sync() latecomer polls _durable under the cv, and an
+                    # unfenced write here could leave it waiting a full
+                    # timeout on a stale value
+                    with self._cv:
+                        self._durable = max(self._durable, upto)
                 except OSError:
                     log.exception("WAL close flush failed")
                 try:

@@ -257,9 +257,11 @@ class MemoryLedger(LedgerBackend):
             self._exp_gen.pop(name, None)
             return existed
 
+    # mtpu: holds(_lock)
     def _index(self, experiment: str) -> Dict[str, set]:
         return self._status_ids.setdefault(experiment, {})
 
+    # mtpu: holds(_lock)
     def _move(self, experiment: str, tid: str, old: Optional[str],
               new: str) -> None:
         idx = self._index(experiment)

@@ -89,6 +89,7 @@ _mixture_logpdf = jax.vmap(
 )
 
 
+# mtpu: hotpath
 @functools.partial(jax.jit, static_argnames=())
 def ei_scores(
     cand: jnp.ndarray,          # (C, d) candidates in the unit cube

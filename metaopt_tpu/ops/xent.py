@@ -82,6 +82,7 @@ def _fwd_scan(y, emb, labels, n_blocks, block_v):
     return lse, lab
 
 
+# mtpu: hotpath
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def blocked_softmax_xent(y, emb, labels, block_v: int = 2048):
     """Per-token ``lse(y·embᵀ) - (y·embᵀ)[label]`` without (N, V) logits.

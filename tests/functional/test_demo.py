@@ -200,6 +200,10 @@ class TestServeCommand:
         shape, end-to-end through two real processes."""
         proc = subprocess.Popen(
             [sys.executable, "-m", "metaopt_tpu", "serve", "--port", "0",
+             # an explicit inner ledger: without it serve falls back to the
+             # config default ~/.metaopt_tpu/ledger, and a previous run's
+             # completed "demo" experiment leaks into this one
+             "--ledger", str(tmp_path / "inner-ledger"),
              "--snapshot", str(tmp_path / "snap.json")],
             stdout=subprocess.PIPE, text=True, cwd=REPO,
         )

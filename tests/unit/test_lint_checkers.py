@@ -1,0 +1,139 @@
+"""Per-family tests for the ``mtpu lint`` checkers (ISSUE 4).
+
+Each bad fixture in ``tests/unit/lint_fixtures/`` must fire its rule id;
+``clean_module.py`` carries the clean counterpart of every shape and
+every checker must stay silent on it. Fixtures are parsed, never
+imported.
+"""
+
+import os
+
+import pytest
+
+from metaopt_tpu.analysis.core import load_paths
+from metaopt_tpu.analysis.durability import check_durability
+from metaopt_tpu.analysis.jax_hygiene import check_jax
+from metaopt_tpu.analysis.locks import check_locks
+from metaopt_tpu.analysis.registry import LintConfig, default_config
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+
+def _mods(name):
+    return load_paths([os.path.join(FIXTURES, name)], root=FIXTURES)
+
+
+def _fixture_cfg():
+    """Declarations for the fixture classes, same shape as the repo's
+    default_config()."""
+    cfg = LintConfig()
+    cfg.lock_attrs = {
+        "Inverted": {"_a_lock", "_b_lock"},
+        "Journal": {"_buf_lock"},
+        "ReplyCache": {"_replies_lock"},
+        "Orderly": {"_a_lock", "_b_lock", "_replies_lock"},
+    }
+    cfg.no_block_locks = {
+        "Journal._buf_lock",
+        "Orderly._a_lock", "Orderly._b_lock", "Orderly._replies_lock",
+    }
+    cfg.guarded_attrs = {
+        "ReplyCache": {"_replies": "ReplyCache._replies_lock"},
+        "Orderly": {"_replies": "Orderly._replies_lock"},
+    }
+    cfg.journaled_ops = frozenset({"register"})
+    return cfg
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# -- lock discipline -------------------------------------------------------
+def test_lock_inversion_fires():
+    fs = check_locks(_mods("bad_lock_inversion.py"), _fixture_cfg())
+    inv = [f for f in fs if f.rule == "MTL001"]
+    assert len(inv) == 2  # both edges of the a<->b cycle
+    details = {f.detail for f in inv}
+    assert details == {"Inverted._a_lock->Inverted._b_lock",
+                       "Inverted._b_lock->Inverted._a_lock"}
+
+
+def test_super_inversion_fires_on_inherited_lock():
+    """Subclass holds an inherited lock while super() re-takes the
+    sibling in base order — the MOTPE.state_dict bug class. The
+    acquisitions must canonicalize to the BASE class's lock nodes."""
+    fs = check_locks(_mods("bad_super_inversion.py"), _fixture_cfg())
+    inv = [f for f in fs if f.rule == "MTL001"]
+    details = {f.detail for f in inv}
+    assert details == {"BaseAlgo._a_lock->BaseAlgo._b_lock",
+                       "BaseAlgo._b_lock->BaseAlgo._a_lock"}
+    assert any(f.symbol == "SubAlgo.snapshot_wrapped" for f in inv)
+
+
+def test_blocking_under_lock_fires_direct_and_transitive():
+    fs = check_locks(_mods("bad_blocking_under_lock.py"), _fixture_cfg())
+    hits = [f for f in fs if f.rule == "MTL002"]
+    syms = {f.symbol for f in hits}
+    assert "Journal.flush_holding_lock" in syms     # direct fsync
+    assert "Journal.nap_holding_lock" in syms       # direct sleep
+    assert "Journal.indirect" in syms               # via _do_fsync summary
+
+
+def test_guarded_write_outside_guard_fires():
+    fs = check_locks(_mods("bad_guarded_write.py"), _fixture_cfg())
+    hits = [f for f in fs if f.rule == "MTL003"]
+    syms = {f.symbol for f in hits}
+    assert "ReplyCache.put_unguarded" in syms       # plain assignment
+    assert "ReplyCache.evict_unguarded" in syms     # .pop() mutation
+    assert "ReplyCache.put_guarded" not in syms     # guarded control
+    assert "ReplyCache.__init__" not in syms        # init writes allowed
+
+
+# -- JAX hygiene -----------------------------------------------------------
+def test_use_after_donation_fires():
+    fs = check_jax(_mods("bad_use_after_donation.py"), default_config())
+    hits = [f for f in fs if f.rule == "MTJ001"]
+    assert len(hits) == 1
+    assert hits[0].detail == "buf"
+    assert hits[0].symbol == "caller"
+
+
+def test_ambient_context_in_jit_fires_transitively():
+    fs = check_jax(_mods("bad_ambient_jit.py"), default_config())
+    hits = [f for f in fs if f.rule == "MTJ002"]
+    # helper is only traced because the jitted kernel calls it
+    assert {f.symbol for f in hits} == {"helper"}
+    assert hits[0].detail == "active_mesh"
+
+
+def test_hotpath_host_sync_fires():
+    fs = check_jax(_mods("bad_hotpath_sync.py"), default_config())
+    hits = [f for f in fs if f.rule == "MTJ003"]
+    assert {f.detail for f in hits} >= {"np.asarray", "item"}
+    assert all(f.symbol == "readback" for f in hits)
+
+
+def test_unhashable_static_arg_fires():
+    fs = check_jax(_mods("bad_static_args.py"), default_config())
+    hits = [f for f in fs if f.rule == "MTJ004"]
+    assert len(hits) == 1
+    assert hits[0].detail == "filled|shape"
+
+
+# -- durability contract ---------------------------------------------------
+def test_unjournaled_op_fires():
+    fs = check_durability(_mods("bad_unjournaled_op.py"), _fixture_cfg())
+    assert "MTD001" in _rules(fs)   # register branch never journals
+    assert "MTD002" in _rules(fs)   # purge mutates but is undeclared
+    d1 = [f for f in fs if f.rule == "MTD001"]
+    assert d1[0].detail == "register"
+
+
+# -- the clean fixture stays silent everywhere -----------------------------
+@pytest.mark.parametrize("checker", [check_locks, check_jax,
+                                     check_durability])
+def test_clean_fixture_is_silent(checker):
+    fs = checker(_mods("clean_module.py"), _fixture_cfg())
+    assert fs == [], "\n".join(f.render() for f in fs)

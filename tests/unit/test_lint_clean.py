@@ -1,0 +1,50 @@
+"""Tier-1 CI gate: ``mtpu lint`` over ``metaopt_tpu/`` must report
+nothing beyond the checked-in baseline (ISSUE 4).
+
+The baseline (metaopt_tpu/analysis/baseline.json) grandfathers the two
+documented deliberate ambient-mesh reads; anything else — a new lock
+inversion, a blocking call under a hot lock, an unguarded write to
+registered shared state, a donation misuse, an unjournaled mutating op —
+fails this test. To accept a new deliberate finding, rerun with
+``mtpu lint --update-baseline`` and justify the diff in review.
+"""
+
+import os
+
+from metaopt_tpu.analysis.runner import (
+    DEFAULT_BASELINE, diff_baseline, lint_main, load_baseline, run_lint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_lint_clean_against_baseline():
+    findings = run_lint([os.path.join(REPO, "metaopt_tpu")], root=REPO)
+    new = diff_baseline(findings, load_baseline(DEFAULT_BASELINE))
+    assert not new, "new lint findings (fix or re-baseline):\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_lint_cli_exit_code(monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    assert lint_main(["metaopt_tpu"]) == 0
+    out = capsys.readouterr().out
+    assert "clean:" in out
+
+
+def test_wal_guarded_write_fix_not_baselined():
+    """The PR-4 true positive — WriteAheadLog.close() publishing
+    ``_durable`` outside ``_cv`` — is FIXED, not grandfathered: the lock
+    checker reports zero MTL003 on the real wal.py."""
+    findings = run_lint(
+        [os.path.join(REPO, "metaopt_tpu", "coord", "wal.py")], root=REPO)
+    bad = [f for f in findings if f.rule == "MTL003"]
+    assert not bad, "\n".join(f.render() for f in bad)
+
+
+def test_baseline_counts_cap_repeat_findings():
+    """A grandfathered fingerprint covers only its captured count — a
+    second instance of the same pattern in the same function is new."""
+    findings = run_lint([os.path.join(REPO, "metaopt_tpu")], root=REPO)
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert diff_baseline(findings + findings[:1], baseline)

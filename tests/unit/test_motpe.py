@@ -155,3 +155,37 @@ class TestAlgorithm:
         algo = make_algorithm(space, {"motpe": {"n_objectives": 2, "seed": 1}})
         assert isinstance(algo, MOTPE)
         assert algo.configuration["motpe"]["n_objectives"] == 2
+
+
+class _RecLock:
+    """Context-manager shim recording acquisition order over a real lock."""
+
+    def __init__(self, inner, name, log):
+        self._inner, self._name, self._log = inner, name, log
+
+    def __enter__(self):
+        self._log.append(self._name)
+        self._inner.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._inner.release()
+        return False
+
+
+class TestLockOrder:
+    def test_persistence_takes_launch_before_kernel(self):
+        """state_dict/load_state_dict must follow TPE's documented
+        launch -> kernel order: taking the kernel lock alone first
+        AB-BA-deadlocks against the speculative-refill thread, which
+        holds launch while waiting for kernel (the inversion mtpu lint
+        rule MTL001 flagged)."""
+        _, mo = make_motpe()
+        order = []
+        mo._launch_lock = _RecLock(mo._launch_lock, "launch", order)
+        mo._kernel_lock = _RecLock(mo._kernel_lock, "kernel", order)
+        state = mo.state_dict()
+        assert order and order[0] == "launch" and "kernel" in order
+        order.clear()
+        mo.load_state_dict(state)
+        assert order and order[0] == "launch" and "kernel" in order

@@ -1,0 +1,71 @@
+"""WriteAheadLog.close() durability publication (PR 4 true positive).
+
+``close()`` used to write ``_durable`` without the condition variable
+every other publisher (``sync()``, ``compact()``) holds — a racing
+``sync()`` latecomer polling ``_durable`` under the cv could miss the
+update and stall a full wait timeout on an already-durable seq. The lint
+rule MTL003 caught it (write to a registered guarded attribute outside
+its declared guard); these tests pin the fixed behavior.
+"""
+
+import threading
+
+from metaopt_tpu.coord.wal import WriteAheadLog, read_records
+
+
+def test_close_flushes_pending_and_publishes_durable(tmp_path):
+    path = str(tmp_path / "w.wal")
+    wal = WriteAheadLog(path).open()
+    seqs = [wal.append({"op": "x", "i": i}) for i in range(5)]
+    # no sync() before close: the records are only buffered
+    assert wal.durable_seq == 0
+    wal.close()
+    assert wal.durable_seq == wal.appended_seq == seqs[-1]
+    records, torn = read_records(path)
+    assert torn == 0
+    assert [r["i"] for r in records] == list(range(5))
+    assert [r["seq"] for r in records] == seqs
+
+
+def test_sync_waiter_released_by_close(tmp_path):
+    """A latecomer blocked in sync() while close() flushes must observe
+    the _durable advance close() publishes (under the cv) and return."""
+    path = str(tmp_path / "w.wal")
+    wal = WriteAheadLog(path).open()
+    seq = wal.append({"op": "x"})
+
+    # make the latecomer wait: mark a sync in progress, then run close()
+    # on another thread — close() waits for _syncing to clear, publishes
+    # the flush under the cv, and notifies
+    with wal._cv:
+        wal._syncing = True
+
+    released = threading.Event()
+
+    def waiter():
+        wal.sync(seq)
+        released.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    closer = threading.Thread(target=wal.close)
+    closer.start()
+    # hand the leader role back so close() can proceed
+    with wal._cv:
+        wal._syncing = False
+        wal._cv.notify_all()
+    closer.join(timeout=5)
+    assert released.wait(timeout=5), "sync() waiter stalled across close()"
+    t.join(timeout=5)
+    assert wal.durable_seq >= seq
+
+
+def test_close_idempotent_and_append_noop_after(tmp_path):
+    path = str(tmp_path / "w.wal")
+    wal = WriteAheadLog(path).open()
+    wal.append({"op": "x"})
+    wal.close()
+    wal.close()  # second close must not raise or regress _durable
+    assert wal.append({"op": "y"}) == 0  # no file: append is a no-op
+    records, _ = read_records(path)
+    assert len(records) == 1
