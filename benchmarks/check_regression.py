@@ -40,6 +40,18 @@ COORD_METRIC = "coord_trials_per_s_32w"
 #: them; then the WAL tax gates like a regression — lower is better)
 WAL_METRIC = "coord_wal_overhead_pct"
 RECOVERY_METRIC = "coord_recovery_time_s"
+#: GP-BO incremental fast path: per-point suggest latency (lower is
+#: better; the key embeds the observation count, which differs by
+#: substrate — 10k on TPU, the 1k side key on a CPU fallback — so the
+#: gate matches artifact and baseline on the SAME key)
+GP_METRICS = ("gp_suggest_ms_per_point_10k_obs",
+              "gp_suggest_ms_per_point_1k_obs")
+#: incremental-vs-full-refit ratio (higher is better); CPU artifacts
+#: additionally enforce the absolute acceptance floor
+GP_SPEEDUP_METRIC = "gp_incremental_speedup_vs_full_refit"
+GP_SPEEDUP_FLOOR = 3.0
+#: speculative suggest-ahead effectiveness (higher is better)
+HIT_RATE_METRICS = ("gp_prefetch_hit_rate", "tpe_prefetch_hit_rate")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -66,6 +78,7 @@ def load_artifact(path: str) -> dict:
             "coord": float(coord) if coord else None,
             "wal_overhead": float(wal) if wal is not None else None,
             "recovery": float(recovery) if recovery is not None else None,
+            "extra": extra,
             "path": path}
 
 
@@ -163,6 +176,65 @@ def main() -> int:
     if art.get("recovery") is not None:
         print(f"{RECOVERY_METRIC}: {art['recovery']:.2f}s "
               "(informational — cold restore + WAL replay)")
+
+    # GP-BO incremental fast path: latency gates like the TPE headline
+    # (lower is better, same key in artifact and baseline); baselines
+    # predating the metric pass informationally
+    extra = art.get("extra") or {}
+    gp_key = next((k for k in GP_METRICS if extra.get(k) is not None), None)
+    gp_bases = ([b for b in matching if b[3].get(gp_key) is not None]
+                if gp_key else [])
+    if gp_key is None or not gp_bases:
+        print("gp_suggest_ms_per_point: artifact or committed baseline "
+              "missing the metric — nothing to gate against (pass)")
+    else:
+        gb_name, _, _, gb_parsed = gp_bases[-1]
+        gp_base = float(gb_parsed[gp_key])
+        gratio = float(extra[gp_key]) / gp_base
+        gverdict = (f"{gp_key}: {float(extra[gp_key]):.3f} ms vs "
+                    f"{gp_base:.3f} ms ({gb_name}, {art['backend']}) "
+                    f"→ {gratio:.3f}x")
+        if gratio > 1.0 + args.threshold:
+            print(f"FAIL {gverdict} — regressed past the "
+                  f"{args.threshold:.0%} threshold")
+            rc = 1
+        else:
+            print(f"OK {gverdict}")
+
+    # the incremental-vs-full-refit ratio must hold its absolute floor on
+    # CPU (the acceptance substrate for the fast path); other substrates
+    # report it informationally
+    speedup = extra.get(GP_SPEEDUP_METRIC)
+    if speedup is None:
+        print(f"{GP_SPEEDUP_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif art["backend"] != "tpu" and float(speedup) < GP_SPEEDUP_FLOOR:
+        print(f"FAIL {GP_SPEEDUP_METRIC}: {float(speedup):.2f}x < the "
+              f"{GP_SPEEDUP_FLOOR:.0f}x acceptance floor")
+        rc = 1
+    else:
+        print(f"OK {GP_SPEEDUP_METRIC}: {float(speedup):.2f}x "
+              f"(floor {GP_SPEEDUP_FLOOR:.0f}x on cpu)")
+
+    # suggest-ahead hit rates: higher is better, gated inversely against
+    # the last baseline that carries them (informational until then)
+    for hkey in HIT_RATE_METRICS:
+        hval = extra.get(hkey)
+        h_bases = [b for b in matching if b[3].get(hkey) is not None]
+        if hval is None or not h_bases:
+            print(f"{hkey}: artifact or committed baseline missing the "
+                  "metric — nothing to gate against (pass)")
+            continue
+        hb_name, _, _, hb_parsed = h_bases[-1]
+        h_base = float(hb_parsed[hkey])
+        hverdict = (f"{hkey}: {float(hval):.3f} vs {h_base:.3f} "
+                    f"({hb_name}, {art['backend']})")
+        if h_base > 0 and float(hval) < h_base * (1.0 - args.threshold):
+            print(f"FAIL {hverdict} — hit rate fell past the "
+                  f"{args.threshold:.0%} threshold")
+            rc = 1
+        else:
+            print(f"OK {hverdict}")
     return rc
 
 

@@ -4,7 +4,10 @@ ref: src/metaopt/algo/ (SURVEY.md §2.3). The BaseAlgorithm contract —
 ``suggest / observe / is_done / score / judge / should_suspend /
 configuration / seed_rng`` — is preserved; algorithm *state* is kept
 explicitly serializable (``state_dict`` / ``load_state_dict``) so the
-coordinator can snapshot and observe-replay on restart.
+coordinator can snapshot and observe-replay on restart. The
+:class:`~metaopt_tpu.algo.base.SuggestAhead` mixin gives TPE, GPBO and
+CMAES a shared speculative suggest-ahead thread (``suggest_prefetch_depth``
+pools banked off the reply path) with drain/atexit hygiene in one place.
 
 Implementations: Random, GridSearch (lazy lattice over the UnitCube),
 GradientDescent (exercises the gradient-result protocol), TPE (KDE
@@ -13,8 +16,10 @@ ASHA, BOHB (TPE-guided Hyperband), EvolutionES, PBT (asynchronous
 population based training with exploit/explore and checkpoint lineage),
 DEHB (differential evolution over the Hyperband ladder), CMAES (the pycma/nevergrad
 plugin family, async generations), GPBO (GP-EI
-Bayesian optimization — the skopt/robo plugin-lineage family — with the
-exact-MLL fit and acquisition as one jitted program), MOTPE
+Bayesian optimization — the skopt/robo plugin-lineage family — with a
+device-resident incremental Cholesky factor extended rank-1 per append,
+warm-started MLL refits, and multi-pool acquisition fused into one
+launch; ``incremental=False`` restores the cold refit-per-suggest), MOTPE
 (multi-objective TPE: NSGA-II Pareto ordering compressed into a scalar
 pseudo-objective feeding the same fused TPE kernel), plus the
 test-support DumbAlgo.

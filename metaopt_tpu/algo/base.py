@@ -17,6 +17,10 @@ design:
 
 from __future__ import annotations
 
+import atexit
+import logging
+import threading
+import weakref
 from abc import ABC, abstractmethod
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -27,6 +31,130 @@ from metaopt_tpu.space import Space
 from metaopt_tpu.utils.registry import Registry
 
 algo_registry: Registry = Registry("algorithm")
+
+#: live SuggestAhead instances whose background threads must finish before
+#: interpreter teardown — a daemon thread mid-XLA at shutdown aborts the
+#: process
+_live_instances: "weakref.WeakSet[SuggestAhead]" = weakref.WeakSet()
+
+
+@atexit.register
+def _drain_background_threads() -> None:
+    for inst in list(_live_instances):
+        for t in (inst._warmup_thread, inst._refill_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=30.0)
+
+
+class SuggestAhead:
+    """Speculative suggest-ahead, shared by the pool-serving algorithms.
+
+    The pattern every device-backed algorithm here converged on: the
+    worker spends its inter-trial time on ledger RPCs and subprocess
+    teardown, which is exactly the window the next pool's kernel launch +
+    readback (or generation advance) can hide in. This mixin owns the
+    thread lifecycle and telemetry; the ALGORITHM owns the work and its
+    locking:
+
+    - call :meth:`_init_suggest_ahead` from the constructor;
+    - implement :meth:`_suggest_ahead_work` — prepare the next pool under
+      the subclass's own locks (TPE doctrine: launch → kernel, never the
+      reverse);
+    - optionally override :meth:`_suggest_ahead_ready` — a cheap unlocked
+      gate checked before any thread is spawned;
+    - fire :meth:`_suggest_ahead_async` wherever the fit changes
+      (``observe`` / ``set_pending``);
+    - report :meth:`_record_pool_hit` / :meth:`_record_pool_miss` when a
+      ``suggest`` is served from the prepared pool vs. pays an inline
+      launch — the bench derives its prefetch-hit-rate from these.
+
+    ``suggest_prefetch_depth`` scales how far ahead the worker runs: 1
+    keeps the historical behaviour (refill only a stale or empty pool),
+    N > 1 keeps N pools' worth of points banked so a burst of produce
+    cycles is answered without ever touching the device inline. Extra
+    pools burn PRNG pool indices at the current fit, which the stream
+    doctrine explicitly allows (keys are ``(n_obs, pool_idx)``, and
+    unserved pools are discarded on fit change), so the SERVED stream
+    stays a pure function of the observe/suggest call sequence.
+
+    At interpreter shutdown a module-level ``atexit`` hook joins the
+    background threads of every live instance (daemon threads mid-XLA
+    abort the process).
+    """
+
+    _warmup_thread: Optional[threading.Thread] = None
+    _refill_thread: Optional[threading.Thread] = None
+
+    def _init_suggest_ahead(self, prefetch_depth: int = 1) -> None:
+        self.suggest_prefetch_depth = max(1, int(prefetch_depth))
+        self._warmup_started = False
+        self._warmup_thread = None
+        self._refill_thread = None
+        self._ahead_launches = 0
+        self._ahead_hits = 0
+        self._ahead_misses = 0
+        _live_instances.add(self)
+
+    # -- subclass surface --------------------------------------------------
+    def _suggest_ahead_ready(self) -> bool:
+        """Cheap gate checked (unlocked) before spawning the worker."""
+        return True
+
+    def _suggest_ahead_work(self) -> None:
+        """Prepare the next pool(s); runs on the background thread under
+        the subclass's own locks."""
+        raise NotImplementedError
+
+    # -- driver ------------------------------------------------------------
+    def _suggest_ahead_async(self) -> None:
+        """Start preparing the next pool the moment the fit changes.
+
+        At most one live refill thread per instance; a fire while one is
+        running is dropped (the running worker re-checks freshness under
+        the subclass's locks before committing, so nothing is lost).
+        Failures are swallowed — the next ``suggest`` simply retries
+        inline.
+        """
+        if not self._suggest_ahead_ready():
+            return
+        if self._refill_thread is not None and self._refill_thread.is_alive():
+            return
+
+        def work() -> None:
+            try:
+                self._suggest_ahead_work()
+            except Exception as exc:  # next suggest() will retry inline
+                logging.getLogger(__name__).debug(
+                    "suggest-ahead refill failed: %s", exc)
+
+        self._ahead_launches += 1
+        self._refill_thread = threading.Thread(
+            target=work, name=f"{type(self).__name__.lower()}-refill",
+            daemon=True,
+        )
+        self._refill_thread.start()
+
+    def drain_suggest_ahead(self, timeout: float = 60.0) -> None:
+        """Join in-flight background threads (tests, bench, shutdown)."""
+        for t in (self._refill_thread, self._warmup_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=timeout)
+
+    # -- telemetry ---------------------------------------------------------
+    def _record_pool_hit(self) -> None:
+        self._ahead_hits += 1
+
+    def _record_pool_miss(self) -> None:
+        self._ahead_misses += 1
+
+    def suggest_ahead_telemetry(self) -> Dict[str, int]:
+        """Counters for the bench: hits = suggests served from a prepared
+        pool without an inline launch; misses paid one."""
+        return {
+            "prefetch_hits": self._ahead_hits,
+            "prefetch_misses": self._ahead_misses,
+            "ahead_launches": self._ahead_launches,
+        }
 
 
 class BaseAlgorithm(ABC):

@@ -30,11 +30,12 @@ from __future__ import annotations
 
 import logging
 import math
+import threading
 from typing import Any, Dict, List, Optional, Set
 
 import numpy as np
 
-from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry
+from metaopt_tpu.algo.base import BaseAlgorithm, SuggestAhead, algo_registry
 from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.space import Space, UnitCube
 
@@ -43,7 +44,7 @@ log = logging.getLogger(__name__)
 
 @algo_registry.register("cmaes")
 @algo_registry.register("cma")
-class CMAES(BaseAlgorithm):
+class CMAES(SuggestAhead, BaseAlgorithm):
     def __init__(
         self,
         space: Space,
@@ -51,6 +52,7 @@ class CMAES(BaseAlgorithm):
         population_size: Optional[int] = None,
         sigma0: float = 0.3,
         max_generations: Optional[int] = None,
+        suggest_prefetch_depth: int = 1,
         **config: Any,
     ):
         super().__init__(
@@ -59,6 +61,7 @@ class CMAES(BaseAlgorithm):
             population_size=population_size,
             sigma0=sigma0,
             max_generations=max_generations,
+            suggest_prefetch_depth=suggest_prefetch_depth,
             **config,
         )
         self.cube = UnitCube(space)
@@ -105,6 +108,14 @@ class CMAES(BaseAlgorithm):
         self._issued = 0
         self._assigned: Set[str] = set()
         self._results: Dict[str, float] = {}          # lineage -> objective
+        # suggest-ahead: the "kernel" here is the host-side generation
+        # math (eigendecomposition + λ draws + CMA update), deterministic
+        # from the observed results — precomputing it off the produce path
+        # cannot change the issued stream. One lock guards ALL mutable
+        # state; held only across host math, never across anything slow.
+        self._kernel_lock = threading.RLock()
+        self._last_prepare_worked = False
+        self._init_suggest_ahead(suggest_prefetch_depth)
 
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:
@@ -115,15 +126,38 @@ class CMAES(BaseAlgorithm):
             self._results[lineage] = obj
         self._assigned.add(lineage)  # absorb strays (replay/insert)
 
+    def observe(self, trials) -> None:
+        with self._kernel_lock:
+            super().observe(trials)
+        # the batch may have completed the cohort: advance the generation
+        # and draw the next λ candidates before the worker asks
+        self._suggest_ahead_async()
+
     # -- suggest -----------------------------------------------------------
     def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
-        out: List[Dict[str, Any]] = []
-        for _ in range(num):
-            pt = self._suggest_one()
-            if pt is None:
-                break  # generation barrier: wait for the cohort
-            out.append(pt)
-        return out
+        with self._kernel_lock:
+            out: List[Dict[str, Any]] = []
+            first = True
+            for _ in range(num):
+                pt = self._suggest_one()
+                if first:
+                    # a hit = the generation math was already done when
+                    # the ask arrived (the background prepare ran)
+                    (self._record_pool_miss if self._last_prepare_worked
+                     else self._record_pool_hit)()
+                    first = False
+                if pt is None:
+                    break  # generation barrier: wait for the cohort
+                out.append(pt)
+            return out
+
+    def _suggest_ahead_work(self) -> None:
+        with self._kernel_lock:
+            self._prepare()
+
+    def telemetry(self) -> Dict[str, int]:
+        """Suggest-ahead counters for the bench (no device traffic here)."""
+        return dict(self.suggest_ahead_telemetry())
 
     def _gen_candidates(self) -> None:
         """Draw generation ``self.generation``'s λ candidates (replay-stable)."""
@@ -145,32 +179,53 @@ class CMAES(BaseAlgorithm):
             self._cand_vecs.append(x)
         self._issued = 0
 
+    def _prepare(self) -> bool:
+        """Advance/materialize until the current cohort can issue.
+
+        The deterministic half of ``_suggest_one``, shared with the
+        suggest-ahead thread: advance fully-observed generations, draw the
+        next cohort, stop when there are candidates to issue (True) or
+        suggesting is pointless (False: max generations, or the catch-up
+        cap). Caller holds ``_kernel_lock``. Sets ``_last_prepare_worked``
+        when any generation math ran — the prefetch-hit telemetry.
+
+        Catch-up loop rationale: a rebuilt instance replaying N completed
+        generations must fast-forward through ALL of them in one call,
+        not burn one idle produce cycle per generation. Bounded: a
+        σ-collapsed distribution can keep hashing onto already-evaluated
+        lineages, and that must not spin forever.
+        """
+        worked = False
+        try:
+            for _ in range(256):
+                cohort = {self.space.hash_point(p) for p in self._candidates}
+                if cohort and cohort <= set(self._results):
+                    self._advance_generation()
+                    worked = True
+                    continue
+                if (self.max_generations is not None
+                        and self.generation >= self.max_generations):
+                    return False
+                if not self._candidates:
+                    self._gen_candidates()
+                    worked = True
+                    continue  # the fresh cohort may itself be fully observed
+                return True
+            return False  # catch-up cap hit (σ-collapse); let is_done decide
+        finally:
+            self._last_prepare_worked = worked
+
     def _suggest_one(self) -> Optional[Dict[str, Any]]:
-        # catch-up loop: a rebuilt instance replaying N completed
-        # generations must fast-forward through ALL of them in one call,
-        # not burn one idle produce cycle per generation. Bounded: a
-        # σ-collapsed distribution can keep hashing onto already-evaluated
-        # lineages, and that must not spin forever.
-        for _ in range(256):
-            cohort = {self.space.hash_point(p) for p in self._candidates}
-            if cohort and cohort <= set(self._results):
-                self._advance_generation()
-                continue
-            if (self.max_generations is not None
-                    and self.generation >= self.max_generations):
-                return None
-            if not self._candidates:
-                self._gen_candidates()
-                continue  # the fresh cohort may itself be fully observed
-            while self._issued < len(self._candidates):
-                pt = self._candidates[self._issued]
-                self._issued += 1
-                lineage = self.space.hash_point(pt)
-                if lineage not in self._assigned:
-                    self._assigned.add(lineage)
-                    return dict(pt)
-            return None  # cohort fully issued; waiting on results
-        return None  # catch-up cap hit (σ-collapse); let is_done decide
+        if not self._prepare():
+            return None
+        while self._issued < len(self._candidates):
+            pt = self._candidates[self._issued]
+            self._issued += 1
+            lineage = self.space.hash_point(pt)
+            if lineage not in self._assigned:
+                self._assigned.add(lineage)
+                return dict(pt)
+        return None  # cohort fully issued; waiting on results
 
     def _advance_generation(self) -> None:
         d = self.cube.n_dims
@@ -235,10 +290,16 @@ class CMAES(BaseAlgorithm):
 
     def seed_rng(self, seed: Optional[int]) -> None:
         super().seed_rng(seed)
-        self._sample_seed = int(self.rng.integers(0, 2**31 - 1))
+        # getattr: callable from the base ctor before the lock exists
+        with getattr(self, "_kernel_lock", threading.RLock()):
+            self._sample_seed = int(self.rng.integers(0, 2**31 - 1))
 
     # -- persistence -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
+        with self._kernel_lock:
+            return self._state_dict_locked()
+
+    def _state_dict_locked(self) -> Dict[str, Any]:
         s = super().state_dict()
         s.update(
             mean=self._mean.tolist(),
@@ -255,6 +316,10 @@ class CMAES(BaseAlgorithm):
         return s
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
+        with self._kernel_lock:
+            self._load_state_dict_locked(state)
+
+    def _load_state_dict_locked(self, state: Dict[str, Any]) -> None:
         super().load_state_dict(state)
         if "mean" in state:
             self._mean = np.asarray(state["mean"], float)

@@ -162,7 +162,11 @@ class MOTPE(TPE):
             self._y = []
             return
         self._y = list(pareto_order_keys(np.asarray(self._F)))
-        self._n_synced = 0  # force a full rewrite of the device y mirror
+        # ranks shift for EXISTING rows too, and the incremental buffer
+        # only appends missing rows — without this the device mirror keeps
+        # serving the pseudo-objectives of an earlier Pareto ordering
+        self._buf.mark_stale()
+        self._aug_key = None  # overlay composed over the stale base
 
     # -- observability -----------------------------------------------------
     def pareto_front(self) -> List[Tuple[Dict[str, Any], List[float]]]:

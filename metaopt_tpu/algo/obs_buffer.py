@@ -27,7 +27,7 @@ bench can report bytes-per-suggest directly.
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -141,6 +141,16 @@ class ObservationBuffer:
             self.h2d_bytes += (self.d + 1) * 4
             self.appends += 1
 
+    def mark_stale(self) -> None:
+        """Force a full re-upload on the next ``sync``.
+
+        For callers that rewrite VALUES of already-synced rows (MOTPE's
+        Pareto pseudo-objectives shift on every insert): ``sync`` only
+        appends missing rows, so a value rewrite would otherwise leave the
+        device mirror serving stale objectives forever.
+        """
+        self.reset()
+
     def overlay(
         self, pend_rows: List[np.ndarray], lie: float
     ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
@@ -160,3 +170,98 @@ class ObservationBuffer:
         )
         self.h2d_bytes += pend.nbytes + lies.nbytes
         return Xa, ya, ntot
+
+
+@functools.partial(jax.jit, static_argnames=("newcap",))
+def _chol_grow(L, newcap: int):
+    """Identity-extend a lower-triangular factor to a larger padding.
+
+    The masked gram makes every row ≥ n an exact unit row ``e_i``, so the
+    factor of the grown matrix is the old factor with an identity corner —
+    no recomputation, device→device copy only. NOT donated: the pending-lie
+    overlay grows a COPY of the live factor, which must survive.
+    """
+    out = jnp.eye(newcap, dtype=L.dtype)
+    return jax.lax.dynamic_update_slice(out, L, (0, 0))
+
+
+class CholeskyFactor:
+    """Device-resident Cholesky factor riding alongside the buffer.
+
+    Owns the factor ARRAY lifecycle (anchor / grow / per-row extend) and
+    the replay trace; the GP owns the kernel math that produces each new
+    ``L`` (the gram row depends on hyperparameters this module must not
+    know about). Between full factorizations ("anchors") the factor is
+    extended one observation row at a time at O(n²) instead of the O(n³)
+    refactorization — the masked gram guarantees the appended row of a
+    dead/padding observation is exactly ``e_i``, so live-path updates and
+    pow2 growth commute bit-for-bit with a from-scratch factorization of
+    the same gram.
+
+    The trace (one anchor + the grow/append ops since, reset at every
+    anchor so it stays bounded by the re-anchor period plus O(log n)
+    grows) lets a restored instance REPLAY the exact op sequence at the
+    exact historical shapes and recover a bit-identical factor — which is
+    what keeps the suggestion stream exactly resumable across
+    ``state_dict`` round-trips despite FP non-associativity.
+    """
+
+    def __init__(self):
+        self.L = None
+        self.cap = 0
+        self.rows = 0        # observation rows folded into the factor
+        self.anchor_n = -1   # observation count at the last full refactor
+        self.anchor_cap = 0
+        self.ops: List[Tuple[str, int]] = []  # ("g", newcap) | ("a", row)
+        # telemetry
+        self.anchors = 0
+        self.extends = 0
+        self.grows = 0
+        self.drift_refits = 0
+
+    def reset(self) -> None:
+        self.L = None
+        self.cap = 0
+        self.rows = 0
+        self.anchor_n = -1
+        self.anchor_cap = 0
+        self.ops = []
+
+    def anchor(self, L, n: int, cap: int) -> None:
+        """Install a fresh full factorization; restarts the replay trace."""
+        self.L = L
+        self.cap = cap
+        self.rows = n
+        self.anchor_n = n
+        self.anchor_cap = cap
+        self.ops = []
+        self.anchors += 1
+
+    def grow(self, newcap: int) -> None:
+        self.L = _chol_grow(self.L, newcap=newcap)
+        self.cap = newcap
+        self.ops.append(("g", newcap))
+        self.grows += 1
+
+    def append_row(self, L, i: int) -> None:
+        """Commit the factor extended through observation row ``i``."""
+        self.L = L
+        self.rows = i + 1
+        self.ops.append(("a", i))
+        self.extends += 1
+
+    def trace(self) -> Dict[str, Any]:
+        """Serializable replay recipe (tiny: ints only, no device data)."""
+        return {
+            "anchor_n": self.anchor_n,
+            "anchor_cap": self.anchor_cap,
+            "ops": [list(op) for op in self.ops],
+        }
+
+    def telemetry(self) -> Dict[str, int]:
+        return {
+            "chol_anchors": self.anchors,
+            "chol_extends": self.extends,
+            "chol_grows": self.grows,
+            "chol_drift_refits": self.drift_refits,
+        }

@@ -19,11 +19,9 @@ variants total and suggest() latency stays flat past 10k observations.
 
 from __future__ import annotations
 
-import atexit
 import logging
 import math
 import threading
-import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -31,7 +29,7 @@ import numpy as np
 
 import jax
 
-from metaopt_tpu.algo.base import BaseAlgorithm, algo_registry
+from metaopt_tpu.algo.base import BaseAlgorithm, SuggestAhead, algo_registry
 from metaopt_tpu.algo.obs_buffer import ObservationBuffer
 from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.ops.tpe_math import (
@@ -43,21 +41,9 @@ from metaopt_tpu.ops.tpe_math import (
 )
 from metaopt_tpu.space import Space, UnitCube
 
-#: live instances whose background threads must finish before interpreter
-#: teardown — a daemon thread mid-XLA at shutdown aborts the process
-_live_instances: "weakref.WeakSet[TPE]" = weakref.WeakSet()
-
-
-@atexit.register
-def _drain_background_threads() -> None:
-    for inst in list(_live_instances):
-        for t in (inst._warmup_thread, inst._refill_thread):
-            if t is not None and t.is_alive():
-                t.join(timeout=30.0)
-
 
 @algo_registry.register("tpe")
-class TPE(BaseAlgorithm):
+class TPE(SuggestAhead, BaseAlgorithm):
     def __init__(
         self,
         space: Space,
@@ -70,6 +56,7 @@ class TPE(BaseAlgorithm):
         equal_weight: bool = False,
         pool_prefetch: int = 8,
         parallel_strategy: Optional[str] = None,
+        suggest_prefetch_depth: int = 1,
         **config: Any,
     ):
         super().__init__(
@@ -83,6 +70,7 @@ class TPE(BaseAlgorithm):
             equal_weight=equal_weight,
             pool_prefetch=pool_prefetch,
             parallel_strategy=parallel_strategy,
+            suggest_prefetch_depth=suggest_prefetch_depth,
             **config,
         )
         self.n_initial_points = n_initial_points
@@ -161,14 +149,12 @@ class TPE(BaseAlgorithm):
         #   background while the initial random trials run
         # - observe() fires a speculative pool refill once EI is active, so
         #   the next suggest() finds its points already computed (or at
-        #   least the launch already in flight)
+        #   least the launch already in flight) — thread lifecycle owned by
+        #   the shared SuggestAhead mixin, work/locking owned here
         self._kernel_lock = threading.RLock()
         self._launch_lock = threading.RLock()
-        self._warmup_started = False
-        self._warmup_thread: Optional[threading.Thread] = None
-        self._refill_thread: Optional[threading.Thread] = None
         self._ei_active = False
-        _live_instances.add(self)
+        self._init_suggest_ahead(suggest_prefetch_depth)
 
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:
@@ -184,7 +170,7 @@ class TPE(BaseAlgorithm):
         # the stale pending set, thrown away, with one PRNG pool index
         # burned scheduling-dependently
         if not self.supports_pending:
-            self._maybe_refill_async()
+            self._suggest_ahead_async()
 
     def set_pending(self, trials) -> None:
         """Reserved trials join the next fit with a lie objective.
@@ -210,7 +196,7 @@ class TPE(BaseAlgorithm):
                 ]
                 self._prefetch = []
                 self._prefetch_n_obs = -1
-        self._maybe_refill_async()
+        self._suggest_ahead_async()
 
     # -- suggest -----------------------------------------------------------
     def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
@@ -268,8 +254,11 @@ class TPE(BaseAlgorithm):
         )
         self._warmup_thread.start()
 
-    def _maybe_refill_async(self) -> None:
-        """Start computing the next pool the moment the fit changes.
+    def _suggest_ahead_ready(self) -> bool:
+        return self._ei_active and len(self._y) >= self.n_initial_points
+
+    def _suggest_ahead_work(self) -> None:
+        """Refill the prefetch pool off the critical path (SuggestAhead).
 
         Fires after ``observe()`` once EI suggesting is active: the worker
         spends its inter-trial time on ledger RPCs and subprocess teardown,
@@ -280,27 +269,21 @@ class TPE(BaseAlgorithm):
         from the same PRNG stream position. The kernel lock is only taken
         for the snapshot and the commit — observe()/set_pending() run
         freely while the kernel itself executes.
+
+        ``suggest_prefetch_depth`` pools are kept banked: at the default
+        depth 1 this refills exactly when the pool is stale or empty (the
+        historical behaviour); deeper settings launch up to ``depth`` pools
+        so bursts of produce cycles never pay an inline launch.
         """
-        if not self._ei_active or len(self._y) < self.n_initial_points:
-            return
-        if self._refill_thread is not None and self._refill_thread.is_alive():
-            return
-
-        def work() -> None:
-            try:
-                with self._launch_lock:
-                    with self._kernel_lock:
-                        needed = (self._prefetch_n_obs != len(self._y)
-                                  or not self._prefetch)
-                    if needed:
-                        self._refill_pool()
-            except Exception as exc:  # next suggest() will retry inline
-                logging.getLogger(__name__).debug("tpe refill failed: %s", exc)
-
-        self._refill_thread = threading.Thread(
-            target=work, name="tpe-refill", daemon=True
-        )
-        self._refill_thread.start()
+        with self._launch_lock:
+            for _ in range(self.suggest_prefetch_depth):
+                with self._kernel_lock:
+                    floor = self.pool_prefetch * (
+                        self.suggest_prefetch_depth - 1)
+                    if (self._prefetch_n_obs == len(self._y)
+                            and len(self._prefetch) > floor):
+                        return
+                self._refill_pool()
 
     def _refill_pool(self, min_points: Optional[int] = None) -> None:
         """One launch appended to the prefetch (caller holds _launch_lock).
@@ -430,6 +413,7 @@ class TPE(BaseAlgorithm):
             "bulk_uploads": b.bulk_uploads,
             "reallocs": b.reallocs,
             "kernel_launches": self._launches,
+            **self.suggest_ahead_telemetry(),
         }
 
     def _suggest_one_ei(self) -> Dict[str, Any]:
@@ -446,6 +430,7 @@ class TPE(BaseAlgorithm):
         (or is in flight — it holds the kernel lock), this serves without
         touching the device at all.
         """
+        served_hot = True
         with self._launch_lock:
             while True:
                 with self._kernel_lock:
@@ -456,8 +441,11 @@ class TPE(BaseAlgorithm):
                     if len(self._prefetch) >= num:
                         out = self._prefetch[:num]
                         self._prefetch = self._prefetch[num:]
+                        (self._record_pool_hit if served_hot
+                         else self._record_pool_miss)()
                         return out
                     missing = num - len(self._prefetch)
+                served_hot = False
                 self._refill_pool(missing)
 
     def _launch_ei(self, num: int) -> List[Dict[str, Any]]:

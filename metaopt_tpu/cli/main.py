@@ -280,6 +280,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="pacemaker: re-free reservations idle this long")
     srv.add_argument("--event-log", dest="event_log_path", default=None,
                      help="JSONL event log path")
+    srv.add_argument("--suggest-prefetch-depth", dest="suggest_prefetch_depth",
+                     type=int, default=None,
+                     help="speculative pools hosted algorithms keep banked "
+                          "so produce legs answer from memory (default 1 = "
+                          "refill-when-stale)")
 
     lint = sub.add_parser(
         "lint",
@@ -1570,6 +1575,10 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
         snapshot_interval_s=args.snapshot_interval_s,
         stale_timeout_s=args.stale_timeout_s,
         event_log_path=args.event_log_path,
+        suggest_prefetch_depth=(
+            args.suggest_prefetch_depth
+            if args.suggest_prefetch_depth is not None
+            else coord_cfg.get("suggest_prefetch_depth", 1)),
     )
     serve_forever(server)
     return 0

@@ -19,7 +19,12 @@ The ``worker_cycle`` op fuses one whole worker trial cycle server-side
 server advertises it (and the other optional ops) via ``caps`` in the
 ``ping`` reply so clients can pick the fast path up front, and clients
 additionally degrade per-op on an ``unknown op`` error for rolling
-upgrades (see ``CoordLedgerClient.worker_cycle``).
+upgrades (see ``CoordLedgerClient.worker_cycle``). The produce leg of a
+hosted cycle is answered from the algorithm's speculative suggest-ahead
+pool when one is banked (``CoordServer(suggest_prefetch_depth=…)`` sets
+how many pools the hosted tpe/gp_bo/cmaes instances keep prepared; the
+coalescer re-arms the pool off the reply path after every cycle), so the
+round-trip cost is the ledger mutation, not the suggestion kernel.
 
 A reply may be served as preencoded bytes (:func:`send_payload`) when the
 server's per-commit reply cache hits — the wire format is identical, the

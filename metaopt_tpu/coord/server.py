@@ -281,6 +281,7 @@ class CoordServer:
         event_log_path: Optional[str] = None,
         host_algorithms: bool = True,
         produce_coalesce_ms: float = 3.0,
+        suggest_prefetch_depth: int = 1,
         wal_path: Optional[str] = None,
         wal: bool = True,
         wal_fsync: bool = True,
@@ -379,6 +380,12 @@ class CoordServer:
         #: observe→suggest→register cycle — see _ProduceCoalescer
         self.produce_coalesce_ms = produce_coalesce_ms
         self._coalescers: Dict[str, _ProduceCoalescer] = {}
+        #: speculative suggest-ahead depth applied to hosted algorithms
+        #: that mix in SuggestAhead (tpe/gp_bo/cmaes): depth N keeps N
+        #: prepared pools banked so the produce leg of a fused
+        #: worker_cycle answers from memory instead of blocking on
+        #: kernel compute; 1 = the historical refill-when-stale default
+        self.suggest_prefetch_depth = max(1, int(suggest_prefetch_depth))
 
     # -- locks / cache plumbing --------------------------------------------
     def _exp_lock(self, name: Optional[str]) -> threading.RLock:
@@ -939,6 +946,9 @@ class CoordServer:
                     raise KeyError(f"experiment {name!r} not found")
                 exp = Experiment(name, ledger=self.ledger).configure()
                 algo = make_algorithm(exp.space, exp.algorithm)
+                if (self.suggest_prefetch_depth > 1
+                        and hasattr(algo, "suggest_prefetch_depth")):
+                    algo.suggest_prefetch_depth = self.suggest_prefetch_depth
                 producer = Producer(exp, algo)
                 # algorithms that never suspend (the base no-op) let the
                 # suspend verdict skip the producer lock entirely — asking
@@ -957,7 +967,7 @@ class CoordServer:
                 entry = (producer, threading.Lock())
                 self._producers[name] = entry
 
-                def on_cycle(batch, _name=name):
+                def on_cycle(batch, _name=name, _algo=algo):
                     res = batch.result or {}
                     if res.get("registered"):
                         self._event(
@@ -966,6 +976,12 @@ class CoordServer:
                             coalesced=res["coalesced"],
                             workers=[w for w in batch.workers if w],
                         )
+                    # re-arm the speculative pool the cycle just drained —
+                    # only spawns a daemon thread, so the waiters blocked
+                    # on batch.done see no added latency
+                    kick = getattr(_algo, "_suggest_ahead_async", None)
+                    if kick is not None:
+                        kick()
 
                 self._coalescers[name] = _ProduceCoalescer(
                     entry[0], entry[1],

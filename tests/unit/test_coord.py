@@ -555,6 +555,30 @@ class TestProduceCoalescing:
             assert out["coalesced"] == 1
             assert out["registered"] == 3
 
+    def test_prefetch_depth_plumbed_and_pool_rearmed_after_cycle(self):
+        # CoordServer(suggest_prefetch_depth=N) applies to hosted algos
+        # that mix in SuggestAhead, and the coalescer re-arms the pool
+        # after every cycle so the NEXT produce leg answers from memory
+        with CoordServer(suggest_prefetch_depth=2) as s:
+            c = _client(s)
+            self._seeded_exp(c, "ahead")
+            out = c.produce("ahead", pool_size=2)
+            assert out["registered"] >= 1
+            algo = s._producers["ahead"][0].algorithm
+            assert algo.suggest_prefetch_depth == 2
+            algo.drain_suggest_ahead()
+            tel = algo.suggest_ahead_telemetry()
+            assert tel["ahead_launches"] >= 1
+            assert len(algo._prefetch) > 0  # a pool is banked for the next leg
+
+    def test_depth_default_leaves_hosted_algo_untouched(self):
+        with CoordServer() as s:
+            c = _client(s)
+            self._seeded_exp(c, "plain")
+            c.produce("plain", pool_size=2)
+            algo = s._producers["plain"][0].algorithm
+            assert algo.suggest_prefetch_depth == 1
+
 
 class TestDeleteExperiment:
     def test_delete_rpc_clears_docs_producer_and_signals(self, server):

@@ -160,6 +160,113 @@ def test_pool_drain_draws_fresh_candidates():
         seen.add(key)
 
 
+class TestIncrementalCholesky:
+    """Property: the incrementally extended factor ≡ a from-scratch
+    factorization of the SAME masked gram — across appends, pow2 buffer
+    growth, dead (diverged) rows, and warm re-anchors."""
+
+    @staticmethod
+    def _full_L(algo):
+        buf, f = algo._buf, algo._factor
+        yd = np.asarray(buf.ydev)[: f.cap]
+        mask = (np.arange(f.cap) < f.rows) & np.isfinite(yd)
+        p = algo._params
+        K = _masked_gram(
+            jnp.asarray(np.asarray(buf.Xdev)[: f.cap]),
+            jnp.asarray(mask.astype(np.float32)),
+            p["log_ls"], p["log_amp"], p["log_noise"],
+        )
+        return np.linalg.cholesky(np.asarray(K, np.float64))
+
+    def test_extension_matches_full_refactorization(self):
+        space = make_space()
+        algo = GPBO(space, seed=2, n_initial_points=3, pool_prefetch=1,
+                    reanchor_every=64, fit_iters=10)
+        algo._suggest_ahead_async = lambda: None  # deterministic timing
+        rng = np.random.default_rng(0)
+
+        def f(p):
+            return (p["x"] - 1.0) ** 2 + (p["y"] + 2.0) ** 2
+
+        checked = 0
+        for k in range(12):
+            pt = algo.suggest(1)[0]
+            if algo._factor.anchor_n >= 0:
+                np.testing.assert_allclose(
+                    np.asarray(algo._factor.L, np.float64),
+                    self._full_L(algo), atol=1e-3, rtol=1e-3)
+                checked += 1
+            n0 = len(algo._y)
+            algo.observe([completed(space, pt, f(pt))])
+            if len(algo._y) == n0:  # EI re-suggested a seen point: dedup
+                filler = {"x": float(rng.uniform(-5, 5)),
+                          "y": float(rng.uniform(-5, 5))}
+                algo.observe([completed(space, filler, f(filler))])
+            if k == 4:  # a diverged trial -> dead (unit) row mid-stream
+                algo.observe([completed(space, {"x": 3.3, "y": 3.3},
+                                        float("nan"))])
+        tel = algo._factor.telemetry()
+        assert checked >= 8
+        assert tel["chol_anchors"] == 1  # never re-anchored...
+        assert tel["chol_extends"] >= 8  # ...every later row was rank-1
+        assert tel["chol_grows"] >= 1    # crossed the cap-8 -> 16 boundary
+
+    def test_reanchor_keeps_equivalence(self):
+        space = make_space()
+        algo = GPBO(space, seed=4, n_initial_points=3, pool_prefetch=1,
+                    reanchor_every=2, refit_iters=5, fit_iters=10)
+        algo._suggest_ahead_async = lambda: None
+        for i in range(9):
+            pt = algo.suggest(1)[0]
+            algo.observe([completed(space, pt, float((i * 7) % 5))])
+        algo.suggest(1)
+        tel = algo._factor.telemetry()
+        assert tel["chol_anchors"] >= 3  # warm re-anchor every 2 appends
+        np.testing.assert_allclose(
+            np.asarray(algo._factor.L, np.float64),
+            self._full_L(algo), atol=1e-3, rtol=1e-3)
+
+    def test_restore_replays_factor_bitwise(self):
+        # the serialized chol trace replays the EXACT programs at the
+        # exact historical shapes, so the restored factor is bitwise
+        # equal to the live one — not merely allclose
+        space = make_space()
+
+        def fresh():
+            a = GPBO(space, seed=6, n_initial_points=3, pool_prefetch=1,
+                     reanchor_every=4)
+            a._suggest_ahead_async = lambda: None
+            return a
+
+        algo = fresh()
+        for i in range(7):
+            pt = algo.suggest(1)[0]
+            algo.observe([completed(space, pt, float(i % 4))])
+        algo.suggest(1)  # factor current at n=7
+        clone = fresh()
+        clone.load_state_dict(algo.state_dict())
+        clone.suggest(1)  # replays the serialized trace lazily
+        assert clone._factor.trace() == algo._factor.trace()
+        assert np.array_equal(np.asarray(algo._factor.L),
+                              np.asarray(clone._factor.L))
+
+    def test_incremental_off_is_cold_refit_per_launch(self):
+        space = make_space()
+        algo = GPBO(space, seed=8, n_initial_points=3, pool_prefetch=1,
+                    incremental=False)
+        algo._suggest_ahead_async = lambda: None
+        for i in range(6):
+            pt = algo.suggest(1)[0]
+            algo.observe([completed(space, pt, float(i))])
+        algo.suggest(1)
+        tel = algo._factor.telemetry()
+        assert tel["chol_extends"] == 0     # no fast path taken
+        assert tel["chol_anchors"] >= 4     # full factor every EI launch
+        np.testing.assert_allclose(
+            np.asarray(algo._factor.L, np.float64),
+            self._full_L(algo), atol=1e-3, rtol=1e-3)
+
+
 class TestPartialDependence:
     def test_curve_minimum_tracks_the_true_optimum(self):
         import numpy as np

@@ -189,3 +189,22 @@ class TestLockOrder:
         order.clear()
         mo.load_state_dict(state)
         assert order and order[0] == "launch" and "kernel" in order
+
+
+class TestDeviceMirrorStaleness:
+    def test_rebuild_keys_refreshes_device_mirror(self):
+        # regression: a new dominating point shifts the ranks of EXISTING
+        # rows, but the incremental observation buffer only appends — the
+        # rebuild must mark the mirror stale so the next sync re-uploads
+        # the rebuilt pseudo-objectives instead of serving the old order
+        space, mo = make_motpe(seed=2)
+        mo._suggest_ahead_async = lambda: None
+        mo.observe([completed(space, {"x": 1.0}, [1.0, 3.0]),
+                    completed(space, {"x": 2.0}, [3.0, 1.0])])
+        mo._buf.sync(mo._X, mo._y)  # mirror holds the front-0 keys
+        mo.observe([completed(space, {"x": 0.5}, [0.5, 0.5])])  # dominates
+        mo._buf.sync(mo._X, mo._y)
+        dev = np.asarray(mo._buf.ydev)[: len(mo._y)]
+        np.testing.assert_allclose(dev, np.asarray(mo._y, np.float32),
+                                   rtol=1e-6)
+        assert dev[0] >= 1.0 and dev[1] >= 1.0  # demoted to front 1

@@ -189,7 +189,7 @@ class TestAsyncLatencyMachinery:
         # refill-disabled instance computes inline
         space, eager = make_tpe(seed=11)
         _, lazy = make_tpe(seed=11)
-        lazy._maybe_refill_async = lambda: None  # disable speculation
+        lazy._suggest_ahead_async = lambda: None  # disable speculation
         trials = [completed(space, {"x": float(i), "c": "a"}, float(i))
                   for i in range(6)]
         for algo in (eager, lazy):
@@ -255,7 +255,7 @@ class TestAsyncLatencyMachinery:
         # fits made (PRNG keyed by (n_obs, pool_idx), not a global counter)
         space, a = make_tpe(seed=21)
         _, b = make_tpe(seed=21)
-        b._maybe_refill_async = lambda: None
+        b._suggest_ahead_async = lambda: None
         batch1 = [completed(space, {"x": float(i), "c": "a"}, float(i))
                   for i in range(6)]
         batch2 = [completed(space, {"x": -3.0, "c": "b"}, -2.0)]
