@@ -849,6 +849,29 @@ def main() -> None:
 
         coord_stats["coord_recovery_time_s"] = coord_run_recovery(
             trials=2000)["recovery_s"]
+
+        # race-detector tax (informational, never gated): the same fused
+        # path under full dynrace instrumentation — what `mtpu race
+        # --suite coord` costs, paired against this run's OWN fused
+        # median like the WAL overhead above
+        from metaopt_tpu.analysis import dynrace
+        from metaopt_tpu.analysis.registry import (default_config,
+                                                   default_race_config)
+
+        monitor = dynrace.monitored_classes(default_config(),
+                                            default_race_config())
+
+        def _raced_run():
+            rt = dynrace.RaceRuntime(monitor)
+            with dynrace.instrument(rt):
+                return coord_run_scale(32, "fused", trials_per_worker=16)
+
+        race_reps = sorted((_raced_run() for _ in range(3)),
+                           key=lambda row: row["trials_per_s"] or 0)
+        race_tps = race_reps[1]["trials_per_s"]
+        if coord_row["trials_per_s"] and race_tps:
+            coord_stats["coord_race_overhead_pct"] = round(
+                100.0 * (1.0 - race_tps / coord_row["trials_per_s"]), 1)
     except Exception as err:  # the TPE headline must survive a coord break
         coord_stats["coord_bench_error"] = f"{type(err).__name__}: {err}"
 
@@ -967,7 +990,8 @@ def main() -> None:
     # rate are measured live on whatever substrate this run has (a CPU
     # fallback carries them under the reduced-n side keys)
     for key in ("coord_trials_per_s_32w", "coord_rpcs_per_trial_32w",
-                "coord_wal_overhead_pct", "coord_recovery_time_s",
+                "coord_wal_overhead_pct", "coord_race_overhead_pct",
+                "coord_recovery_time_s",
                 "gp_suggest_ms_per_point_1k_obs",
                 "gp_full_refit_ms_per_point_1k_obs",
                 "gp_incremental_speedup_vs_full_refit",

@@ -89,6 +89,11 @@ class SuggestAhead:
         self.suggest_prefetch_depth = max(1, int(prefetch_depth))
         self._warmup_started = False
         self._warmup_thread = None
+        # guards the spawn decision and the telemetry counters: both are
+        # touched from the caller thread and the refill thread, and the
+        # check-then-spawn below must be atomic or two near-simultaneous
+        # fires launch two refill threads racing on the same pool
+        self._ahead_lock = threading.Lock()
         self._refill_thread = None
         self._ahead_launches = 0
         self._ahead_hits = 0
@@ -117,8 +122,6 @@ class SuggestAhead:
         """
         if not self._suggest_ahead_ready():
             return
-        if self._refill_thread is not None and self._refill_thread.is_alive():
-            return
 
         def work() -> None:
             try:
@@ -127,34 +130,46 @@ class SuggestAhead:
                 logging.getLogger(__name__).debug(
                     "suggest-ahead refill failed: %s", exc)
 
-        self._ahead_launches += 1
-        self._refill_thread = threading.Thread(
-            target=work, name=f"{type(self).__name__.lower()}-refill",
-            daemon=True,
-        )
-        self._refill_thread.start()
+        with self._ahead_lock:
+            if (self._refill_thread is not None
+                    and self._refill_thread.is_alive()):
+                return
+            self._ahead_launches += 1
+            # start under the lock: an unstarted Thread reports
+            # is_alive() False, so publishing it before start() would
+            # let a concurrent fire spawn a second refill
+            self._refill_thread = threading.Thread(
+                target=work, name=f"{type(self).__name__.lower()}-refill",
+                daemon=True,
+            )
+            self._refill_thread.start()
 
     def drain_suggest_ahead(self, timeout: float = 60.0) -> None:
         """Join in-flight background threads (tests, bench, shutdown)."""
-        for t in (self._refill_thread, self._warmup_thread):
+        with self._ahead_lock:
+            refill = self._refill_thread
+        for t in (refill, self._warmup_thread):
             if t is not None and t.is_alive():
                 t.join(timeout=timeout)
 
     # -- telemetry ---------------------------------------------------------
     def _record_pool_hit(self) -> None:
-        self._ahead_hits += 1
+        with self._ahead_lock:
+            self._ahead_hits += 1
 
     def _record_pool_miss(self) -> None:
-        self._ahead_misses += 1
+        with self._ahead_lock:
+            self._ahead_misses += 1
 
     def suggest_ahead_telemetry(self) -> Dict[str, int]:
         """Counters for the bench: hits = suggests served from a prepared
         pool without an inline launch; misses paid one."""
-        return {
-            "prefetch_hits": self._ahead_hits,
-            "prefetch_misses": self._ahead_misses,
-            "ahead_launches": self._ahead_launches,
-        }
+        with self._ahead_lock:
+            return {
+                "prefetch_hits": self._ahead_hits,
+                "prefetch_misses": self._ahead_misses,
+                "ahead_launches": self._ahead_launches,
+            }
 
 
 class BaseAlgorithm(ABC):

@@ -53,7 +53,8 @@ class LintConfig:
     guarded_attrs: Dict[str, Dict[str, str]] = field(default_factory=dict)
     #: methods where unguarded writes are allowed (single-threaded phases)
     init_methods: Set[str] = field(
-        default_factory=lambda: {"__init__", "__new__"})
+        default_factory=lambda: {"__init__", "__new__",
+                                 "_init_suggest_ahead"})
     #: receiver-name roles for cross-class call resolution:
     #: "proxy" = the server's sharded-ledger proxy (mutators acquire EXP
     #: and journal to the WAL buffer), "wal" = WriteAheadLog, "backend" =
@@ -155,6 +156,92 @@ def _strip_frozenset(node: ast.AST) -> ast.AST:
     return node
 
 
+@dataclass
+class RaceConfig:
+    """Declarations specific to ``mtpu race`` (the dynamic detector and
+    the MTR001 shared-attribute check). Kept separate from
+    :class:`LintConfig` because the race side needs *imports* (live
+    classes to hook) where lint needs only ASTs."""
+
+    #: {ClassName: "module.path"} — classes whose instances get
+    #: ``__setattr__``/``__getattribute__`` hooks under instrumentation.
+    #: Monitored attrs = guarded_attrs merged down the MRO (a mixin's
+    #: declarations apply to every adopter) plus ``extra_monitored``.
+    monitor_modules: Dict[str, str] = field(default_factory=dict)
+    #: {ClassName: {attr}} — monitored dynamically without a lint guard
+    #: declaration (e.g. attrs protected by happens-before, not a lock)
+    extra_monitored: Dict[str, Set[str]] = field(default_factory=dict)
+    #: {(ClassName, attr)} — excluded from dynamic monitoring AND from
+    #: MTR001, with the doctrine recorded here. Use sparingly.
+    race_exempt: Set[Tuple[str, str]] = field(default_factory=set)
+    #: extra thread-entry-point qualnames for the static shared-attribute
+    #: computation, beyond the ``Thread(target=...)``/``_spawn`` targets
+    #: found in the AST (RPC handlers run on connection threads; client
+    #: methods run on arbitrary caller threads).
+    entry_points: Set[str] = field(default_factory=set)
+
+
+def default_race_config() -> RaceConfig:
+    """Checked-in race-detection declarations for this repository.
+
+    Exemption doctrine (each entry is a *deliberate* lock-free pattern,
+    not an oversight):
+
+    * ``CoordServer._mut`` — per-experiment mutation counters. Written
+      under EXP (``_mutated`` holds the experiment lock); the delta-read
+      fast path polls it lock-free as a freshness hint, tolerating a
+      stale value by design (a stale read serves a slightly old delta,
+      never a wrong one). GIL-atomic int store; declared for MTL003 but
+      exempt from the dynamic read/write check.
+    * ``WriteAheadLog._appended`` — monotone telemetry counter written
+      under ``_buf_lock`` and read lock-free by ``stats()``/tests as a
+      progress probe; same stale-tolerant doctrine.
+    * ``WriteAheadLog._failed`` — sticky degradation flag. Writes are
+      fenced under ``_cv`` (MTL003 enforces this); the ``append()`` hot
+      path reads it lock-free because a stale False merely buffers one
+      more record that the next sync() will reject.
+    * ``WriteAheadLog._f`` — the file handle. Mutual exclusion is the
+      ``_syncing`` leader flag elected under ``_cv`` (exactly one thread
+      does I/O at a time); open()/close() are lifecycle phases.
+    * ``CoordServer._ops`` — ops-served telemetry snapshot returned by
+      ping; GIL-atomic int store, stale reads are the contract.
+    * ``CoordServer._sock`` / ``_threads`` / ``_prev_switchinterval`` /
+      ``_wal`` — start()/stop()/recovery lifecycle attrs, written before
+      serving threads exist or after they are joined. The static check
+      accuses them because the bare-name call graph resolves any
+      ``x.start()`` into ``CoordServer.start`` (and ``self._wal.append``
+      counts as a container write to ``_wal``).
+    """
+    rc = RaceConfig()
+    rc.monitor_modules = {
+        "CoordServer": "metaopt_tpu.coord.server",
+        "WriteAheadLog": "metaopt_tpu.coord.wal",
+        "CoordLedgerClient": "metaopt_tpu.coord.client_backend",
+        "MemoryLedger": "metaopt_tpu.ledger.backends",
+        "CMAES": "metaopt_tpu.algo.cmaes",
+    }
+    rc.race_exempt = {
+        ("CoordServer", "_mut"),
+        ("CoordServer", "_ops"),
+        ("CoordServer", "_sock"),
+        ("CoordServer", "_threads"),
+        ("CoordServer", "_prev_switchinterval"),
+        ("CoordServer", "_wal"),
+        ("WriteAheadLog", "_appended"),
+        ("WriteAheadLog", "_failed"),
+        ("WriteAheadLog", "_f"),
+    }
+    rc.entry_points = {
+        # every RPC runs on a per-connection thread
+        "CoordServer._handle",
+        # WAL group commit runs on caller threads (no background thread)
+        "WriteAheadLog.append", "WriteAheadLog.sync",
+        # client methods run on arbitrary worker threads
+        "CoordLedgerClient.worker_cycle",
+    }
+    return rc
+
+
 def default_config() -> LintConfig:
     """The checked-in declarations for this repository.
 
@@ -183,6 +270,7 @@ def default_config() -> LintConfig:
         "CoordLedgerClient": {"_lock", "_caps_lock", "_live_lock"},
         "MemoryLedger": {"_lock"},
         "_ProduceCoalescer": {"_guard"},
+        "SuggestAhead": {"_ahead_lock"},
     }
     cfg.lock_factories = {
         "_exp_lock": (EXP_LOCK, ["CoordServer._exp_locks_guard"]),
@@ -213,6 +301,9 @@ def default_config() -> LintConfig:
             "_enc_hits": "CoordServer._enc_lock",
             "_producers": "CoordServer._producers_guard",
             "_coalescers": "CoordServer._producers_guard",
+            # per-experiment mutation counters for the delta-read path;
+            # written only while holding the experiment's lock
+            "_mut": EXP_LOCK,
         },
         "WriteAheadLog": {
             "_pending": "WriteAheadLog._buf_lock",
@@ -220,6 +311,12 @@ def default_config() -> LintConfig:
             "_appended": "WriteAheadLog._buf_lock",
             "_durable": "WriteAheadLog._cv",
             "_syncing": "WriteAheadLog._cv",
+            # sticky failure flag: latecomers poll it under the cv, so
+            # every publication must be fenced the same way as _durable
+            "_failed": "WriteAheadLog._cv",
+            # batch/record telemetry incremented per group commit
+            "batches": "WriteAheadLog._buf_lock",
+            "records": "WriteAheadLog._buf_lock",
         },
         "CoordLedgerClient": {
             "_caps": "CoordLedgerClient._caps_lock",
@@ -234,6 +331,15 @@ def default_config() -> LintConfig:
             "_new_heap": "MemoryLedger._lock",
             "_completed_log": "MemoryLedger._lock",
             "_exp_gen": "MemoryLedger._lock",
+        },
+        "SuggestAhead": {
+            # speculative-refill pool bookkeeping: the spawn decision and
+            # the hit/miss/launch telemetry are touched from the caller
+            # thread AND the refill thread
+            "_refill_thread": "SuggestAhead._ahead_lock",
+            "_ahead_hits": "SuggestAhead._ahead_lock",
+            "_ahead_misses": "SuggestAhead._ahead_lock",
+            "_ahead_launches": "SuggestAhead._ahead_lock",
         },
     }
     cfg.receiver_roles = {

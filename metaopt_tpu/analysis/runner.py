@@ -15,16 +15,21 @@ import argparse
 import json
 import os
 import sys
+import time
 from collections import Counter
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from metaopt_tpu.analysis.core import Finding, load_paths
 from metaopt_tpu.analysis.durability import check_durability
 from metaopt_tpu.analysis.jax_hygiene import check_jax
-from metaopt_tpu.analysis.locks import check_locks
-from metaopt_tpu.analysis.registry import LintConfig, default_config
+from metaopt_tpu.analysis.locks import LockChecker
+from metaopt_tpu.analysis.registry import (LintConfig, RaceConfig,
+                                           default_config,
+                                           default_race_config)
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+DEFAULT_RACE_BASELINE = os.path.join(os.path.dirname(__file__),
+                                     "race_baseline.json")
 #: fingerprints embed paths relative to the REPO root (the directory
 #: holding the metaopt_tpu package), never the caller's cwd — the
 #: checked-in baseline must match from anywhere `mtpu lint` is invoked
@@ -33,15 +38,31 @@ REPO_ROOT = os.path.dirname(os.path.dirname(
 PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _sort_key(f: Finding) -> Tuple[str, int, str, str, str]:
+    """(path, line, code, detail, symbol): total order, so repeated runs
+    and ``--update-baseline`` produce byte-identical output."""
+    return (f.file, f.line, f.rule, f.detail, f.symbol)
+
+
 def run_lint(paths: Sequence[str], cfg: Optional[LintConfig] = None,
-             root: Optional[str] = None) -> List[Finding]:
+             root: Optional[str] = None,
+             race_cfg: Optional[RaceConfig] = None) -> List[Finding]:
+    """All static families over ONE parse: ``load_paths`` reads+parses
+    each file once, and the lock-graph summaries (the expensive pass)
+    are built once and shared between the MTL checks and — when a
+    ``race_cfg`` is given — the MTR001 shared-attribute check."""
     cfg = cfg or default_config()
     modules = load_paths(paths, root=root)
+    checker = LockChecker(modules, cfg)
     findings: List[Finding] = []
-    findings += check_locks(modules, cfg)
+    findings += checker.run()
     findings += check_jax(modules, cfg)
     findings += check_durability(modules, cfg)
-    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.detail))
+    if race_cfg is not None:
+        from metaopt_tpu.analysis.dynrace import check_shared
+
+        findings += check_shared(modules, cfg, race_cfg, checker=checker)
+    findings.sort(key=_sort_key)
     return findings
 
 
@@ -106,10 +127,125 @@ def lint_main(argv: Optional[Sequence[str]] = None,
     ap.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
 
+    t0 = time.monotonic()
     try:
-        findings = run_lint(args.paths, cfg=cfg, root=REPO_ROOT)
+        findings = run_lint(args.paths, cfg=cfg, root=REPO_ROOT,
+                            race_cfg=default_race_config())
     except (OSError, SyntaxError) as e:
         print(f"mtpu lint: {e}", file=sys.stderr)
+        return 2
+    runtime_s = time.monotonic() - t0
+
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"baseline updated: {len(findings)} finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    baseline = Counter() if args.no_baseline else load_baseline(
+        args.baseline)
+    new = diff_baseline(findings, baseline)
+    grandfathered = len(findings) - len(new)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "grandfathered": grandfathered,
+            "lint_runtime_s": round(runtime_s, 3),
+            "total": len(findings),
+        }, indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        note = (f"{len(new)} new finding(s), "
+                f"{grandfathered} grandfathered by baseline")
+        print(("FAIL: " if new else "clean: ") + note)
+    return 1 if new else 0
+
+
+def run_race(suites: Sequence[str], cfg: Optional[LintConfig] = None,
+             race_cfg: Optional[RaceConfig] = None,
+             scale: int = 1, static: bool = True,
+             paths: Optional[Sequence[str]] = None
+             ) -> Tuple[List[Finding], Dict[str, float]]:
+    """Static MTR001 + the instrumented dynamic suites.
+
+    Returns (findings, stats). Suites run sequentially, each under its
+    own :class:`~metaopt_tpu.analysis.dynrace.RaceRuntime` so one
+    suite's access history can't alias another's recycled object ids.
+    """
+    from metaopt_tpu.analysis import dynrace
+    from metaopt_tpu.analysis.suites import SUITES
+
+    cfg = cfg or default_config()
+    race_cfg = race_cfg or default_race_config()
+    findings: List[Finding] = []
+    stats: Dict[str, float] = {}
+    t0 = time.monotonic()
+    if static:
+        modules = load_paths(paths or [PKG_DIR], root=REPO_ROOT)
+        checker = LockChecker(modules, cfg)
+        findings += dynrace.check_shared(modules, cfg, race_cfg,
+                                         checker=checker)
+        stats["static_runtime_s"] = round(time.monotonic() - t0, 3)
+    monitor = dynrace.monitored_classes(cfg, race_cfg)
+    events = 0
+    for name in suites:
+        if name not in SUITES:
+            raise ValueError(f"unknown race suite {name!r} "
+                             f"(have: {', '.join(sorted(SUITES))})")
+        t1 = time.monotonic()
+        rt = dynrace.RaceRuntime(monitor, root=REPO_ROOT)
+        with dynrace.instrument(rt):
+            SUITES[name](scale)
+        findings += rt.findings()
+        events += rt.events
+        stats[f"suite_{name}_s"] = round(time.monotonic() - t1, 3)
+    stats["events"] = events
+    stats["runtime_s"] = round(time.monotonic() - t0, 3)
+    findings.sort(key=_sort_key)
+    return findings, stats
+
+
+def race_main(argv: Optional[Sequence[str]] = None,
+              cfg: Optional[LintConfig] = None,
+              race_cfg: Optional[RaceConfig] = None) -> int:
+    """CLI body shared by ``mtpu race`` and the tier-1 gate test."""
+    ap = argparse.ArgumentParser(
+        prog="mtpu race",
+        description="hybrid race detection: static shared-attribute "
+                    "check (MTR001) + lockset/vector-clock instrumented "
+                    "concurrency suites (MTR101 data races, MTR102 "
+                    "lock-order inversions)")
+    ap.add_argument("--suite", action="append", default=None,
+                    choices=("coord", "algo", "wal", "all"),
+                    help="workload(s) to run instrumented (repeatable; "
+                         "default: all)")
+    ap.add_argument("--scale", type=int, default=1,
+                    help="iteration multiplier (1 = fast CI run)")
+    ap.add_argument("--static-only", action="store_true",
+                    help="run only the MTR001 static check, no workloads")
+    ap.add_argument("--baseline", default=DEFAULT_RACE_BASELINE,
+                    help="grandfathered-findings file (default: the "
+                         "checked-in analysis/race_baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this run's findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    suites = args.suite or ["all"]
+    if "all" in suites:
+        suites = ["coord", "algo", "wal"]
+    if args.static_only:
+        suites = []
+
+    try:
+        findings, stats = run_race(suites, cfg=cfg, race_cfg=race_cfg,
+                                   scale=max(1, args.scale))
+    except (OSError, SyntaxError) as e:
+        print(f"mtpu race: {e}", file=sys.stderr)
         return 2
 
     if args.update_baseline:
@@ -127,12 +263,17 @@ def lint_main(argv: Optional[Sequence[str]] = None,
         print(json.dumps({
             "findings": [f.__dict__ for f in new],
             "grandfathered": grandfathered,
+            "stats": stats,
+            "suites": suites,
             "total": len(findings),
         }, indent=1, sort_keys=True))
     else:
         for f in new:
             print(f.render())
         note = (f"{len(new)} new finding(s), "
-                f"{grandfathered} grandfathered by baseline")
+                f"{grandfathered} grandfathered by baseline "
+                f"[suites: {', '.join(suites) or 'none'}; "
+                f"{int(stats.get('events', 0))} events in "
+                f"{stats.get('runtime_s', 0.0):.1f}s]")
         print(("FAIL: " if new else "clean: ") + note)
     return 1 if new else 0

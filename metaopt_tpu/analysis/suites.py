@@ -1,0 +1,192 @@
+"""Designated concurrency workloads for ``mtpu race``.
+
+Each suite is a short, deterministic-in-shape (not in interleaving)
+workload chosen to push the repo's real thread families through their
+shared state while instrumented:
+
+* ``coord`` — a live :class:`CoordServer` with WAL + snapshots enabled
+  and aggressive housekeeping, under 8 client threads running the fused
+  ``worker_cycle`` loop with deferred ``complete`` legs. Exercises
+  accept/conn/sender threads, the sharded per-experiment locks, the
+  reply cache, group commit, and the sweep/snapshot loop.
+* ``algo`` — CMA-ES (numpy-only: no compile cost inside the detector)
+  with ``suggest_prefetch_depth=2``, a driver thread running
+  suggest/observe generations against the SuggestAhead refill thread,
+  and a prober thread hammering ``state_dict`` + telemetry — the
+  workload shape that held the PR-4 MOTPE lock-order inversion.
+* ``wal`` — 4 appender threads doing append+sync group commits against
+  a compactor thread and a final close(), kill-free (the chaos fault
+  points stay unarmed), on a real file so fsync windows are realistic.
+
+Suites construct everything they touch INSIDE the instrumented region
+(locks must be minted under instrumentation to be wrapped) and join all
+their threads before returning — the conftest leak check and the
+detector's join-edges both depend on it.
+
+``scale`` multiplies iteration counts: 1 is the tier-1-friendly fast
+run, the ``slow``-marked chaos-length variant passes more.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Callable, Dict, List
+
+
+def suite_coord(scale: int = 1) -> None:
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+
+    workers = 8
+    budget = workers * 6 * scale
+    with tempfile.TemporaryDirectory() as td:
+        snap = os.path.join(td, "coord.snap")
+        with CoordServer(snapshot_path=snap, snapshot_interval_s=0.2,
+                         stale_timeout_s=5.0, sweep_interval_s=0.1) as s:
+            host, port = s.address
+            c0 = CoordLedgerClient(host=host, port=port)
+            Experiment(
+                "race-coord", c0,
+                space=build_space({"x": "uniform(-5, 5)"}),
+                max_trials=budget, pool_size=workers,
+                algorithm={"random": {"seed": 7}},
+            ).configure()
+            errors: List[BaseException] = []
+            # odd workers share ONE client: cross-thread client state
+            # (caps cache, live-reservation map, socket lock) is part of
+            # the surface — benchmarks/coord_scale.py shares clients too
+            shared = CoordLedgerClient(host=host, port=port)
+
+            def worker(i: int) -> None:
+                try:
+                    c = (shared if i % 2
+                         else CoordLedgerClient(host=host, port=port))
+                    complete = None
+                    for _ in range(budget * 4):
+                        out = c.worker_cycle(
+                            "race-coord", f"w{i}", pool_size=workers,
+                            complete=complete)
+                        complete = None
+                        t = out["trial"]
+                        if t is None:
+                            if out["counts"]["completed"] >= budget:
+                                return
+                            continue
+                        t.attach_results([{
+                            "name": "objective", "type": "objective",
+                            "value": (t.params["x"] - 1) ** 2,
+                        }])
+                        t.transition("completed")
+                        complete = {"trial": t.to_dict(),
+                                    "expected_status": "reserved",
+                                    "expected_worker": f"w{i}"}
+                except BaseException as e:  # surfaced by the runner
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        name=f"race-worker-{i}")
+                       for i in range(workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120.0)
+            if errors:
+                raise errors[0]
+
+
+def suite_algo(scale: int = 1) -> None:
+    from metaopt_tpu.algo import CMAES
+    from metaopt_tpu.ledger.trial import Trial
+    from metaopt_tpu.space import build_space
+
+    space = build_space({"x": "uniform(-5, 5)", "y": "uniform(-5, 5)"})
+    algo = CMAES(space, seed=11, population_size=6,
+                 suggest_prefetch_depth=2)
+    stop = threading.Event()
+    errors: List[BaseException] = []
+
+    def prober() -> None:
+        # the PR-4 MOTPE inversion lived exactly here: state_dict
+        # racing the speculative refill thread's lock acquisitions
+        try:
+            while not stop.is_set():
+                algo.state_dict()
+                algo.suggest_ahead_telemetry()
+        except BaseException as e:
+            errors.append(e)
+
+    p = threading.Thread(target=prober, name="race-prober")
+    p.start()
+    try:
+        for gen in range(8 * scale):
+            pts = algo.suggest(6)
+            if not pts:
+                break
+            trials = []
+            for pt in pts:
+                t = Trial(params=pt, experiment="race-algo")
+                t.lineage = space.hash_point(pt)
+                t.transition("reserved")
+                t.attach_results([{
+                    "name": "o", "type": "objective",
+                    "value": (pt["x"] - 1.0) ** 2 + (pt["y"] + 2.0) ** 2,
+                }])
+                t.transition("completed")
+                trials.append(t)
+            algo.observe(trials)
+    finally:
+        stop.set()
+        p.join(timeout=30.0)
+        algo.drain_suggest_ahead()
+    if errors:
+        raise errors[0]
+
+
+def suite_wal(scale: int = 1) -> None:
+    from metaopt_tpu.coord.wal import WriteAheadLog
+
+    per_thread = 25 * scale
+    with tempfile.TemporaryDirectory() as td:
+        wal = WriteAheadLog(os.path.join(td, "race.wal")).open()
+        stop = threading.Event()
+        errors: List[BaseException] = []
+
+        def appender(i: int) -> None:
+            try:
+                for n in range(per_thread):
+                    seq = wal.append({"op": "race", "w": i, "n": n})
+                    wal.sync(seq)
+            except BaseException as e:
+                errors.append(e)
+
+        def compactor() -> None:
+            try:
+                while not stop.is_set():
+                    wal.compact(upto_seq=max(0, wal.durable_seq - 20))
+                    stop.wait(0.01)
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=appender, args=(i,),
+                                    name=f"race-wal-{i}") for i in range(4)]
+        threads.append(threading.Thread(target=compactor,
+                                        name="race-wal-compact"))
+        for t in threads:
+            t.start()
+        for t in threads[:-1]:
+            t.join(timeout=60.0)
+        stop.set()
+        threads[-1].join(timeout=30.0)
+        wal.close()
+        if errors:
+            raise errors[0]
+
+
+SUITES: Dict[str, Callable[[int], None]] = {
+    "coord": suite_coord,
+    "algo": suite_algo,
+    "wal": suite_wal,
+}

@@ -303,6 +303,28 @@ def build_parser() -> argparse.ArgumentParser:
     lint.add_argument("--format", choices=("text", "json"),
                       default="text", dest="lint_format")
 
+    race = sub.add_parser(
+        "race",
+        help="hybrid race detection: static shared-attribute check plus "
+             "lockset/vector-clock instrumented concurrency suites",
+    )
+    race.add_argument("--suite", action="append", default=None,
+                      choices=("coord", "algo", "wal", "all"),
+                      help="workload(s) to run instrumented (repeatable; "
+                           "default: all)")
+    race.add_argument("--scale", type=int, default=1,
+                      help="iteration multiplier (1 = fast CI run)")
+    race.add_argument("--static-only", action="store_true",
+                      help="run only the MTR001 static check, no workloads")
+    race.add_argument("--baseline", default=None,
+                      help="grandfathered-findings file (default: the "
+                           "checked-in analysis/race_baseline.json)")
+    race.add_argument("--update-baseline", action="store_true")
+    race.add_argument("--no-baseline", action="store_true",
+                      help="report every finding, ignore the baseline")
+    race.add_argument("--format", choices=("text", "json"),
+                      default="text", dest="race_format")
+
     return p
 
 
@@ -1681,9 +1703,30 @@ def _cmd_lint(args: argparse.Namespace, cfg: Dict[str, Any]) -> int:
     return lint_main(argv)
 
 
+def _cmd_race(args: argparse.Namespace, cfg: Dict[str, Any]) -> int:
+    from metaopt_tpu.analysis.runner import race_main
+
+    argv: List[str] = []
+    for s in args.suite or []:
+        argv += ["--suite", s]
+    if args.scale != 1:
+        argv += ["--scale", str(args.scale)]
+    if args.static_only:
+        argv.append("--static-only")
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.update_baseline:
+        argv.append("--update-baseline")
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    argv += ["--format", args.race_format]
+    return race_main(argv)
+
+
 _COMMANDS = {
     "hunt": _cmd_hunt,
     "lint": _cmd_lint,
+    "race": _cmd_race,
     "benchmark": _cmd_benchmark,
     "init-only": _cmd_init_only,
     "insert": _cmd_insert,

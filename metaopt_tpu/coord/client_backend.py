@@ -261,13 +261,19 @@ class CoordLedgerClient(LedgerBackend):
         use; a pre-caps server (no "caps" in its ping reply) reports
         nothing, and callers then rely on per-op "unknown op" degradation
         for anything they still optimistically try."""
-        if self._caps is None:
+        # snapshot under the declared guard: clients are shared across
+        # worker threads, and ping()/degradation rewrite the tuple
+        with self._caps_lock:
+            caps = self._caps
+        if caps is None:
             try:
                 self.ping()
             except CoordRPCError:
                 with self._caps_lock:
                     self._caps = ()
-        return cap in (self._caps or ())
+            with self._caps_lock:
+                caps = self._caps
+        return cap in (caps or ())
 
     # -- experiment docs ---------------------------------------------------
     def create_experiment(self, config: Dict[str, Any]) -> None:

@@ -397,6 +397,7 @@ class CoordServer:
                 lk = self._exp_locks[name] = threading.RLock()
             return lk
 
+    # mtpu: holds(EXP)
     def _mutated(self, name: Optional[str]) -> None:
         """Record a commit against ``name`` (caller holds its exp lock)."""
         if name:

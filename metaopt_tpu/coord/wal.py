@@ -185,11 +185,13 @@ class WriteAheadLog:
 
     @property
     def appended_seq(self) -> int:
-        return self._appended
+        with self._buf_lock:
+            return self._appended
 
     @property
     def durable_seq(self) -> int:
-        return self._durable
+        with self._cv:
+            return self._durable
 
     # -- hot path ---------------------------------------------------------
     def append(self, rec: Dict[str, Any]) -> int:
@@ -250,7 +252,11 @@ class WriteAheadLog:
             # waiting (and the server logs loudly) rather than deadlocking
             # every reply behind a dead disk
             log.exception("WAL write/fsync failed — durability degraded")
-            self._failed = True
+            # fence like _durable: latecomers poll _failed under the cv,
+            # and an unfenced store could leave one waiting a full
+            # timeout on a stale value
+            with self._cv:
+                self._failed = True
         finally:
             with self._cv:
                 self._syncing = False
@@ -274,8 +280,11 @@ class WriteAheadLog:
         self._f.flush()
         if self.fsync:
             os.fsync(self._f.fileno())
-        self.batches += 1
-        self.records += len(batch)
+        # counters are read by stats()/bench from other threads; the
+        # lock is taken AFTER the I/O so fsync never runs under it
+        with self._buf_lock:
+            self.batches += 1
+            self.records += len(batch)
 
     # -- maintenance ------------------------------------------------------
     def compact(self, upto_seq: int) -> None:
@@ -305,7 +314,8 @@ class WriteAheadLog:
                 self._write_batch(batch)
             except OSError:
                 log.exception("WAL compact flush failed")
-                self._failed = True
+                with self._cv:
+                    self._failed = True
                 return
             records, _ = read_records(self.path, truncate_torn=False)
             tail = [r for r in records if r.get("seq", 0) > upto_seq]

@@ -1,0 +1,37 @@
+"""Tier-1 CI gate: ``mtpu race`` over the repo's own concurrency
+workloads must report nothing beyond the checked-in race baseline
+(ISSUE 6).
+
+Mirror of ``test_lint_clean.py`` for the dynamic half: the static
+MTR001 shared-attribute check plus the coord/algo/wal instrumented
+suites. A finding here is either a real regression (fix it) or a new
+deliberate pattern — justify it and rerun with
+``mtpu race --update-baseline``. The chaos-length variant runs the
+same suites at 5x iterations and is ``slow``-marked.
+"""
+
+import pytest
+
+from metaopt_tpu.analysis.runner import (DEFAULT_RACE_BASELINE,
+                                         diff_baseline, load_baseline,
+                                         race_main, run_race)
+
+
+def test_static_shared_attrs_clean():
+    # MTR001 alone: every attribute written from >= 2 thread entry points
+    # is either lock-declared, guard-declared or doctrine-exempted
+    findings, stats = run_race([], static=True)
+    new = diff_baseline(findings, load_baseline(DEFAULT_RACE_BASELINE))
+    assert not new, "undeclared shared attributes:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_race_suites_clean():
+    # the full hybrid run, exactly as `mtpu race` ships it: fails only
+    # on non-baselined regressions (exit 1), never on grandfathered ones
+    assert race_main(["--suite", "all"]) == 0
+
+
+@pytest.mark.slow
+def test_race_suites_clean_chaos_length():
+    assert race_main(["--suite", "all", "--scale", "5"]) == 0

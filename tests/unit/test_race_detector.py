@@ -1,0 +1,242 @@
+"""Engine tests for ``mtpu race``'s dynamic half (ISSUE 6).
+
+Two layers:
+
+* toy workloads over a monitored ``Box`` prove the happens-before edges
+  (fork/join, lock release->acquire, Event set->wait) suppress reports
+  and that genuinely unordered unlocked writes produce exactly one
+  MTR101 with both stacks;
+* the seeded-bug fixtures in ``tests/unit/race_fixtures/`` — copies of
+  the two concurrency bugs PR 4 fixed, with the fixes reverted — must be
+  REdiscovered by the detector with the exact rule, attribute/edge and
+  both sides' stacks. Fixtures are imported standalone (never part of
+  the package) and run under their own :class:`RaceRuntime`.
+"""
+
+import importlib.util
+import os
+import threading
+import time
+
+from metaopt_tpu.analysis import dynrace
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "race_fixtures")
+
+
+def _load(name):
+    """Import a race fixture as a standalone module (fresh class objects
+    per test, so monitor hooks never leak between tests)."""
+    spec = importlib.util.spec_from_file_location(
+        f"race_fixture_{name}", os.path.join(FIXDIR, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class Box:
+    def __init__(self):
+        self.val = 0
+
+
+def _rt(monitor):
+    return dynrace.RaceRuntime(monitor, root=REPO)
+
+
+def _spin(flag, key):
+    while not flag[key]:
+        time.sleep(0.0005)
+
+
+# -- happens-before edges ---------------------------------------------------
+
+
+def test_fork_join_edges_order_accesses():
+    rt = _rt({Box: frozenset({"val"})})
+    with dynrace.instrument(rt):
+        b = Box()
+
+        def child():
+            b.val = 1
+
+        t = threading.Thread(target=child)
+        t.start()
+        t.join()
+        assert b.val == 1  # read ordered by the join edge
+        b.val = 2
+    assert rt.findings() == []
+
+
+def test_lock_guard_suppresses_report():
+    rt = _rt({Box: frozenset({"val"})})
+    with dynrace.instrument(rt):
+        b = Box()
+        lk = threading.Lock()
+        flag = {"first": False}
+
+        def w1():
+            with lk:
+                b.val = 1
+            flag["first"] = True
+
+        def w2():
+            _spin(flag, "first")
+            with lk:
+                b.val = 2
+
+        ts = [threading.Thread(target=w1), threading.Thread(target=w2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert rt.findings() == []
+
+
+def test_event_edge_orders_accesses():
+    # disjoint locksets (none at all), but set() -> wait() is an ordering
+    # edge: the detector must stay silent
+    rt = _rt({Box: frozenset({"val"})})
+    with dynrace.instrument(rt):
+        b = Box()
+        ev = threading.Event()
+
+        def w1():
+            b.val = 1
+            ev.set()
+
+        def w2():
+            ev.wait()
+            b.val = 2
+
+        ts = [threading.Thread(target=w1), threading.Thread(target=w2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert rt.findings() == []
+
+
+def test_unordered_unlocked_writes_race():
+    # same schedule as the Event test but ordered only by wall clock (a
+    # plain-dict spin is invisible to the detector — as it should be:
+    # flag polling is not synchronization)
+    rt = _rt({Box: frozenset({"val"})})
+    with dynrace.instrument(rt):
+        b = Box()
+        flag = {"first": False}
+
+        def w1():
+            b.val = 1
+            flag["first"] = True
+
+        def w2():
+            _spin(flag, "first")
+            b.val = 2
+
+        ts = [threading.Thread(target=w1), threading.Thread(target=w2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    races = [f for f in rt.findings() if f.rule == "MTR101"]
+    assert len(races) == 1
+    f = races[0]
+    assert f.symbol == "Box.val"
+    assert "write/write" in f.message
+    assert f.message.count("no locks held") == 2
+    assert f.message.count("in w1") >= 1 and f.message.count("in w2") >= 1
+
+
+def test_primitives_survive_uninstrument():
+    rt = _rt({})
+    with dynrace.instrument(rt):
+        lk = threading.Lock()
+        cv = threading.Condition()
+    # wrapped objects built under instrumentation must keep working (and
+    # emit nothing) after the patches are unwound
+    events_after = rt.events
+    with lk:
+        pass
+    with cv:
+        cv.notify_all()
+    assert rt.events == events_after
+
+
+# -- seeded-bug rediscovery -------------------------------------------------
+
+
+def test_wal_close_race_rediscovered():
+    wal_mod = _load("wal_close_race")
+    rt = _rt({wal_mod.RacyWriteAheadLog: frozenset({"_durable"})})
+    with dynrace.instrument(rt):
+        w = wal_mod.RacyWriteAheadLog()
+        w.append({"op": "probe"})
+        gate = {"parked": False, "go": False}
+
+        def park():
+            gate["parked"] = True
+            _spin(gate, "go")
+
+        w.before_publish = park
+        closer = threading.Thread(target=w.close, name="closer")
+        closer.start()
+        _spin(gate, "parked")
+        # the racing read: under the cv, while close() sits right before
+        # its unfenced durability publish
+        assert w.durable_seq == 0
+        gate["go"] = True
+        closer.join()
+    races = [f for f in rt.findings() if f.rule == "MTR101"]
+    assert len(races) == 1, "\n".join(f.render() for f in rt.findings())
+    f = races[0]
+    assert f.symbol == "RacyWriteAheadLog._durable"
+    assert f.detail == "close|durable_seq"
+    assert f.file == os.path.join("tests", "unit", "race_fixtures",
+                                  "wal_close_race.py")
+    assert "read/write" in f.message
+    # both sides, with their locksets: the reader held the cv, the
+    # closer published bare — that asymmetry IS the reverted fix
+    assert "holding RacyWriteAheadLog._cv" in f.message
+    assert "no locks held" in f.message
+    assert "in durable_seq" in f.message
+    assert "in close" in f.message
+
+
+def test_motpe_inversion_rediscovered():
+    mod = _load("motpe_inversion")
+    rt = _rt({mod.MiniTPE: frozenset()})
+    with dynrace.instrument(rt):
+        m = mod.MiniMOTPE()
+        m.suggest()      # launch -> kernel (the base-class order)
+        m.state_dict()   # kernel -> launch (the reverted override)
+    inv = [f for f in rt.findings() if f.rule == "MTR102"]
+    details = {f.detail for f in inv}
+    assert "MiniTPE._kernel_lock->MiniTPE._launch_lock" in details, details
+    f = next(f for f in inv
+             if f.detail == "MiniTPE._kernel_lock->MiniTPE._launch_lock")
+    # both direction stacks in one report: the override's grab and the
+    # base path it inverts
+    assert "in state_dict" in f.message
+    assert "in suggest" in f.message
+    assert "completes a cycle" in f.message
+
+
+def test_clean_fixture_run_reports_nothing():
+    # the same WAL fixture run WITHOUT exercising the buggy window (no
+    # concurrent probe) must be silent — rediscovery is the schedule's
+    # doing, not an attribute blacklist's
+    wal_mod = _load("wal_close_race")
+    rt = _rt({wal_mod.RacyWriteAheadLog: frozenset({"_durable"})})
+    with dynrace.instrument(rt):
+        w = wal_mod.RacyWriteAheadLog()
+        seq = w.append({"op": "probe"})
+        w.sync(seq)
+        assert w.durable_seq == seq
+        closer = threading.Thread(target=w.close)
+        closer.start()
+        closer.join()
+        assert w.durable_seq == seq
+    assert rt.findings() == [], "\n".join(
+        f.render() for f in rt.findings())
