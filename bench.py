@@ -872,6 +872,34 @@ def main() -> None:
         if coord_row["trials_per_s"] and race_tps:
             coord_stats["coord_race_overhead_pct"] = round(
                 100.0 * (1.0 - race_tps / coord_row["trials_per_s"]), 1)
+
+        # sharded deployment: subprocess shards (one WAL each) behind the
+        # consistent-hash map. The workload spreads 4 experiments across
+        # the shards; the overhead pct pairs the 1-shard figure against
+        # this run's OWN in-process fused+wal at the SAME multi-experiment
+        # workload (same durability, same run — ratio doctrine). On the
+        # one-core CI box shard2/shard4 time-slice a single core, so their
+        # absolute numbers are informational; the gated figure is the
+        # 1-shard process tax
+        shard_base_reps = sorted(
+            (coord_run_scale(32, "fused+wal", trials_per_worker=16,
+                             experiments=4)
+             for _ in range(3)),
+            key=lambda row: row["trials_per_s"] or 0,
+        )
+        shard_base_tps = shard_base_reps[1]["trials_per_s"]
+        for n_shards in (1, 2, 4):
+            shard_reps = sorted(
+                (coord_run_scale(32, "sharded", trials_per_worker=16,
+                                 shards=n_shards, experiments=4)
+                 for _ in range(3)),
+                key=lambda row: row["trials_per_s"] or 0,
+            )
+            shard_tps = shard_reps[1]["trials_per_s"]
+            coord_stats[f"coord_trials_per_s_shard{n_shards}"] = shard_tps
+            if n_shards == 1 and shard_base_tps and shard_tps:
+                coord_stats["coord_shard_overhead_pct"] = round(
+                    100.0 * (1.0 - shard_tps / shard_base_tps), 1)
     except Exception as err:  # the TPE headline must survive a coord break
         coord_stats["coord_bench_error"] = f"{type(err).__name__}: {err}"
 
@@ -992,6 +1020,8 @@ def main() -> None:
     for key in ("coord_trials_per_s_32w", "coord_rpcs_per_trial_32w",
                 "coord_wal_overhead_pct", "coord_race_overhead_pct",
                 "coord_recovery_time_s",
+                "coord_trials_per_s_shard1", "coord_trials_per_s_shard2",
+                "coord_trials_per_s_shard4", "coord_shard_overhead_pct",
                 "gp_suggest_ms_per_point_1k_obs",
                 "gp_full_refit_ms_per_point_1k_obs",
                 "gp_incremental_speedup_vs_full_refit",

@@ -40,6 +40,13 @@ COORD_METRIC = "coord_trials_per_s_32w"
 #: them; then the WAL tax gates like a regression — lower is better)
 WAL_METRIC = "coord_wal_overhead_pct"
 RECOVERY_METRIC = "coord_recovery_time_s"
+#: sharded deployment: per-shard-count throughput (higher is better,
+#: inverse gate like COORD_METRIC) and the 1-shard process tax vs the
+#: in-process durable server (lower is better, pct-point slack like the
+#: WAL tax). All informational until a committed baseline carries them.
+SHARD_TPS_METRICS = ("coord_trials_per_s_shard1", "coord_trials_per_s_shard2",
+                     "coord_trials_per_s_shard4")
+SHARD_OVERHEAD_METRIC = "coord_shard_overhead_pct"
 #: GP-BO incremental fast path: per-point suggest latency (lower is
 #: better; the key embeds the observation count, which differs by
 #: substrate — 10k on TPU, the 1k side key on a CPU fallback — so the
@@ -176,6 +183,46 @@ def main() -> int:
     if art.get("recovery") is not None:
         print(f"{RECOVERY_METRIC}: {art['recovery']:.2f}s "
               "(informational — cold restore + WAL replay)")
+
+    # sharded serving: throughputs gate inversely (higher is better) and
+    # the 1-shard process tax gates with pct-point slack, each against the
+    # last committed baseline that carries it — informational until then
+    art_extra = art.get("extra") or {}
+    for skey in SHARD_TPS_METRICS:
+        sval = art_extra.get(skey)
+        s_bases = [b for b in matching if b[3].get(skey)]
+        if sval is None or not s_bases:
+            print(f"{skey}: artifact or committed baseline missing the "
+                  "metric — nothing to gate against (pass)")
+            continue
+        sb_name, _, _, sb_parsed = s_bases[-1]
+        s_base = float(sb_parsed[skey])
+        sratio = float(sval) / s_base
+        sverdict = (f"{skey}: {float(sval):.0f} vs {s_base:.0f} trials/s "
+                    f"({sb_name}, {art['backend']}) → {sratio:.3f}x")
+        if sratio < 1.0 - args.threshold:
+            print(f"FAIL {sverdict} — throughput regressed past the "
+                  f"{args.threshold:.0%} threshold")
+            rc = 1
+        else:
+            print(f"OK {sverdict}")
+    so_val = art_extra.get(SHARD_OVERHEAD_METRIC)
+    so_bases = [b for b in matching
+                if b[3].get(SHARD_OVERHEAD_METRIC) is not None]
+    if so_val is None or not so_bases:
+        print(f"{SHARD_OVERHEAD_METRIC}: artifact or committed baseline "
+              "missing the metric — nothing to gate against (pass)")
+    else:
+        sob_name, _, _, sob_parsed = so_bases[-1]
+        so_base = float(sob_parsed[SHARD_OVERHEAD_METRIC])
+        soverdict = (f"{SHARD_OVERHEAD_METRIC}: {float(so_val):.1f}% vs "
+                     f"{so_base:.1f}% ({sob_name}, {art['backend']})")
+        if float(so_val) > so_base + args.threshold * 100.0:
+            print(f"FAIL {soverdict} — shard process tax grew past the "
+                  f"baseline by more than {args.threshold * 100:.0f} points")
+            rc = 1
+        else:
+            print(f"OK {soverdict}")
 
     # GP-BO incremental fast path: latency gates like the TPE headline
     # (lower is better, same key in artifact and baseline); baselines

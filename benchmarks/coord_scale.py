@@ -37,8 +37,22 @@ the fused vs fused+wal delta is the durability tax, gated at <10% by
 benchmarks/check_regression.py. `--recovery` additionally times a crash
 restart (restore + replay of a 2000-trial WAL).
 
+"sharded" (via --shards) is the multi-process deployment: a
+ShardSupervisor hosting N subprocess CoordServer shards, each with its
+own WAL, clients routing directly by the consistent-hash shard map. The
+workload spreads `--shard-experiments` experiments across the shards
+(workers split evenly), and the SAME multi-experiment workload runs
+against the in-process durable server in the SAME invocation — every
+reported ratio is same-run/same-machine, because PR 3 showed absolute
+trials/s drifts >10% between sessions on the CI box and poisons
+cross-session comparisons. On a one-core box sharding cannot scale (the
+shards time-slice one core); the honest figure there is the 1-shard
+overhead vs the in-process server, which the regression gate bounds.
+
     python benchmarks/coord_scale.py [--workers 1 8 32]
                                      [--modes serial fused fused+wal]
+                                     [--shards 1 2 4]
+                                     [--shard-experiments 4]
                                      [--trials-per-worker 16]
                                      [--recovery] [--save]
 
@@ -83,18 +97,49 @@ def _percentile(sorted_vals, q):
     return sorted_vals[i]
 
 
-def _make_server(mode: str, produce_coalesce_ms: float):
+def _balanced_names(shard_map, count: int):
+    """``count`` experiment names spread as evenly as the ring allows
+    across the map's shards — the sharded workload must exercise every
+    shard, not land all its experiments on one by hash accident."""
+    from metaopt_tpu.coord.shards import ring_of
+
+    ring = ring_of(shard_map)
+    n = len(shard_map["shards"])
+    cap = -(-count // n)  # ceil
+    per: dict = {}
+    names = []
+    i = 0
+    while len(names) < count and i < 100000:
+        nm = f"cs-exp{i}"
+        sid = ring.owner(nm)
+        if per.get(sid, 0) < cap:
+            per[sid] = per.get(sid, 0) + 1
+            names.append(nm)
+        i += 1
+    return names
+
+
+def _make_server(mode: str, produce_coalesce_ms: float, shards=None):
     """The coordinator under test; ``serial`` gets the pre-fast-path
     dispatch shape so the baseline is the pre-change server, not the new
     server driven serially. ``fused+wal`` is the shipped server with the
     write-ahead log on (group-commit fsync before every mutating reply) —
     the fused/fused+wal ratio is the durability tax the regression gate
-    bounds at 10%."""
+    bounds at 10%. ``sharded`` is the multi-process deployment: N
+    subprocess shards, one WAL each, under a ShardSupervisor."""
     import shutil
     import tempfile
 
     from metaopt_tpu.coord import CoordServer
 
+    if mode == "sharded":
+        from metaopt_tpu.coord.shards import ShardSupervisor
+
+        wal_dir = tempfile.mkdtemp(prefix="coordscale-shards-")
+        sup = ShardSupervisor(shards or 1, snapshot_dir=wal_dir,
+                              produce_coalesce_ms=produce_coalesce_ms)
+        sup._bench_cleanup = lambda: shutil.rmtree(wal_dir, True)
+        return sup
     if mode == "fused+wal":
         wal_dir = tempfile.mkdtemp(prefix="coordscale-wal-")
         server = CoordServer(
@@ -132,14 +177,20 @@ def run_scale(
     pool_size: int = 8,
     produce_coalesce_ms: float = 0.0,
     seed: int = 0,
+    shards: int = None,
+    experiments: int = 1,
 ) -> dict:
-    """One config: N threaded workers drain one experiment through one
-    in-process coordinator; returns the throughput/latency row.
+    """One config: N threaded workers drain ``experiments`` experiments
+    through one coordinator deployment; returns the throughput/latency
+    row.
 
     ``mode="serial"`` is the pre-change deployment (legacy-dispatch
     server + per-op wire sequence); ``mode="fused"`` the shipped one —
     same machine, same run, which is what makes the fused/serial ratio a
-    like-for-like RPC-plane comparison.
+    like-for-like RPC-plane comparison. ``mode="sharded"`` runs
+    ``shards`` subprocess shards (one WAL each) under a ShardSupervisor,
+    clients routing directly by the shard map; compare it against an
+    in-process mode at the SAME ``experiments`` in the same invocation.
     """
     from metaopt_tpu.coord import CoordLedgerClient
     from metaopt_tpu.executor import InProcessExecutor
@@ -147,8 +198,10 @@ def run_scale(
     from metaopt_tpu.space import build_space
     from metaopt_tpu.worker import workon
 
-    if mode not in ("serial", "fused", "fused+wal"):
+    if mode not in ("serial", "fused", "fused+wal", "sharded"):
         raise ValueError(f"unknown mode {mode!r}")
+    # an experiment with zero workers would deadlock its drain
+    experiments = max(1, min(experiments, workers))
 
     lat_lock = threading.Lock()
     latencies: list = []
@@ -168,8 +221,7 @@ def run_scale(
                     latencies.append(dt)
                     op_counts[op] = op_counts.get(op, 0) + 1
 
-    max_trials = workers * trials_per_worker
-    server = _make_server(mode, produce_coalesce_ms)
+    server = _make_server(mode, produce_coalesce_ms, shards)
     server.start()
     try:
         host, port = server.address
@@ -178,26 +230,42 @@ def run_scale(
             # a pre-worker_cycle coordinator advertises only these; the
             # client then composes cycles from the serial RPC sequence
             client._caps = ("count", "fetch_completed_since")
-
-        exp = Experiment(
-            f"coordscale-{mode}-{workers}w",
-            client,
-            space=build_space(SPACE),
-            algorithm={"random": {"seed": seed}},
-            max_trials=max_trials,
-            pool_size=pool_size,
-        ).configure()
-        # warm the hosted-producer path (algorithm construction + its
-        # imports) before the clock: the first produce of a fresh process
-        # otherwise pays a one-time ~100s-of-ms setup inside whichever
-        # mode's window runs first — registers one normal pool that the
-        # workers then drain as part of the run
-        client.produce(exp.name, pool_size)
+        if mode == "sharded":
+            # learn the shard map before the clock so the measured window
+            # is direct-routed, and spread the experiments across shards
+            client.ping()
+            assert client._ring is not None, "shard map not learned"
+            names = _balanced_names(server.shard_map, experiments)
+        else:
+            names = [f"coordscale-{mode}-{workers}w-{e}"
+                     for e in range(experiments)]
+        # workers round-robin over experiments; each experiment's budget
+        # matches its worker count so every mode drains the same totals
+        exp_workers = [
+            sum(1 for i in range(workers) if i % len(names) == e)
+            for e in range(len(names))
+        ]
+        for e, name in enumerate(names):
+            Experiment(
+                name,
+                client,
+                space=build_space(SPACE),
+                algorithm={"random": {"seed": seed + e}},
+                max_trials=exp_workers[e] * trials_per_worker,
+                pool_size=pool_size,
+            ).configure()
+            # warm the hosted-producer path (algorithm construction + its
+            # imports) before the clock: the first produce of a fresh
+            # process otherwise pays a one-time ~100s-of-ms setup inside
+            # whichever mode's window runs first — registers one normal
+            # pool that the workers then drain as part of the run
+            client.produce(name, pool_size)
 
         # worker Experiments are built (1 doc load each) before the clock
         # starts; the measured window is pure drain
         worker_exps = [
-            Experiment(exp.name, client).configure() for _ in range(workers)
+            Experiment(names[i % len(names)], client).configure()
+            for i in range(workers)
         ]
         threads = []
         # start the window with an empty collector debt: on a one-core box
@@ -228,19 +296,22 @@ def run_scale(
             lat_sorted = sorted(latencies)
             ops = dict(op_counts)
         n_calls = sum(ops.values())
-        completed = client.count(exp.name, "completed")
+        completed = sum(client.count(nm, "completed") for nm in names)
         # steady-state RPCs per trial: one-time ramp excluded — the caps
-        # probe ping, the experiment create/config round-trips, the main
+        # probe ping, the experiment create/config round-trips, each
         # experiment's configure load + warmup produce, and each worker's
         # bootstrap (configure's doc load + the first loop iteration's
         # full is_done evaluation: doc load + 2 counts) — an identical
-        # allowance for both modes
+        # allowance for every mode
         ramp = (ops.get("ping", 0) + ops.get("create_experiment", 0)
-                + ops.get("update_experiment", 0) + 2 + 4 * workers)
+                + ops.get("update_experiment", 0) + 2 * len(names)
+                + 4 * workers)
         steady = max(0, n_calls - ramp)
         return {
             "mode": mode,
             "workers": workers,
+            **({"shards": shards or 1} if mode == "sharded" else {}),
+            **({"experiments": len(names)} if len(names) > 1 else {}),
             "trials": completed,
             "wall_s": round(wall, 3),
             "trials_per_s": round(completed / wall, 2) if wall else None,
@@ -327,6 +398,19 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workers", nargs="*", type=int, default=[1, 8, 32])
     ap.add_argument("--modes", nargs="*", default=["serial", "fused"])
+    ap.add_argument(
+        "--shards", nargs="*", type=int, default=None, metavar="N",
+        help="also run the sharded deployment at these shard counts; "
+             "implies a fused+wal in-process baseline at the same "
+             "multi-experiment workload in the SAME run (ratios, not "
+             "cross-session absolutes)",
+    )
+    ap.add_argument(
+        "--shard-experiments", type=int, default=4,
+        help="experiments the sharded (and its baseline) workload spreads "
+             "across shards — one experiment lives on one shard, so "
+             "sharding can only scale a multi-experiment pod",
+    )
     ap.add_argument("--trials-per-worker", type=int, default=16)
     ap.add_argument("--produce-coalesce-ms", type=float, default=0.0)
     ap.add_argument(
@@ -344,32 +428,50 @@ def main():
 
     from metaopt_tpu.utils.provenance import provenance
 
+    # each config is (key, mode, extra run_scale kwargs); the sharded
+    # configs ride as pseudo-modes so they interleave with the in-process
+    # baselines inside the SAME repeat loop (ratio doctrine: never compare
+    # a sharded number against a baseline from a different invocation)
+    configs = [(m, m, {}) for m in args.modes]
+    if args.shards:
+        exp = args.shard_experiments
+        # the sharded figure is meaningless without the same-durability
+        # in-process baseline at the same multi-experiment workload — a
+        # dedicated config even when fused+wal is also listed in --modes,
+        # because that one runs the single-experiment workload
+        configs.append(("wal-base", "fused+wal", {"experiments": exp}))
+        for s in args.shards:
+            configs.append((f"shard{s}", "sharded",
+                            {"shards": s, "experiments": exp}))
+
     rows = []
+    by: dict = {}
     for n in args.workers:
-        # interleave the modes within each repeat, alternating which goes
+        # interleave the configs within each repeat, alternating which goes
         # first: a long-lived process speeds up run over run (allocator and
         # cache warm-up), so consecutive same-mode repeats would hand the
         # later-scheduled mode a systematic advantage
-        per_mode: dict = {m: [] for m in args.modes}
+        per_key: dict = {k: [] for k, _, _ in configs}
         errors: dict = {}
         for r in range(max(1, args.repeats)):
-            order = (list(args.modes) if r % 2 == 0
-                     else list(reversed(args.modes)))
-            for mode in order:
+            order = (list(configs) if r % 2 == 0
+                     else list(reversed(configs)))
+            for key, mode, extra in order:
                 try:
-                    per_mode[mode].append(run_scale(
+                    per_key[key].append(run_scale(
                         n, mode=mode,
                         trials_per_worker=args.trials_per_worker,
                         produce_coalesce_ms=args.produce_coalesce_ms,
+                        **extra,
                     ))
                 except Exception as err:
-                    errors[mode] = f"{type(err).__name__}: {err}"
-        for mode in args.modes:
-            reps = sorted(per_mode[mode],
+                    errors[key] = f"{type(err).__name__}: {err}"
+        for key, mode, _ in configs:
+            reps = sorted(per_key[key],
                           key=lambda r: r["trials_per_s"] or 0)
             if not reps:
                 row = {"mode": mode, "workers": n,
-                       "error": errors.get(mode, "no successful runs")}
+                       "error": errors.get(key, "no successful runs")}
             else:
                 row = reps[len(reps) // 2]  # median by throughput
                 if len(reps) > 1:
@@ -380,10 +482,10 @@ def main():
             row.update(provenance())
             print(json.dumps(row), flush=True)
             rows.append(row)
+            by[(key, n)] = row
     # the headline ratio the regression gate rides on: fused vs serial at
     # the widest fan-in measured in the SAME run on the SAME machine
     widest = max(args.workers) if args.workers else 0
-    by = {(r.get("mode"), r.get("workers")): r for r in rows}
     f, s = by.get(("fused", widest)), by.get(("serial", widest))
     if f and s and f.get("trials_per_s") and s.get("trials_per_s"):
         print(json.dumps({
@@ -407,6 +509,39 @@ def main():
             "wal_batches": w.get("wal_batches"),
             "wal_records": w.get("wal_records"),
         }), flush=True)
+    if args.shards:
+        base = by.get(("wal-base", widest))
+        one = by.get(("shard1", widest))
+        # the process tax: 1 sharded subprocess (WAL on) vs the in-process
+        # durable server on the SAME multi-experiment workload — the figure
+        # check_regression.py bounds on one-core CI where scaling can't show
+        if (base and one and base.get("trials_per_s")
+                and one.get("trials_per_s")):
+            print(json.dumps({
+                "summary": f"shard_overhead_{widest}w",
+                "shard_overhead_pct": round(
+                    100.0 * (1.0 - one["trials_per_s"]
+                             / base["trials_per_s"]), 1),
+                "inproc_wal_trials_per_s": base["trials_per_s"],
+                "shard1_trials_per_s": one["trials_per_s"],
+                "experiments": args.shard_experiments,
+            }), flush=True)
+        # shard scaling: every count vs shard1, same run (≥1.7x at 2 shards
+        # is the multi-core acceptance figure; ~1.0x expected on one core)
+        if one and one.get("trials_per_s"):
+            for s in sorted(set(args.shards)):
+                if s == 1:
+                    continue
+                rs = by.get((f"shard{s}", widest))
+                if rs and rs.get("trials_per_s"):
+                    print(json.dumps({
+                        "summary": f"shard_scaling_{s}x_{widest}w",
+                        "speedup_vs_shard1": round(
+                            rs["trials_per_s"] / one["trials_per_s"], 2),
+                        "shard1_trials_per_s": one["trials_per_s"],
+                        f"shard{s}_trials_per_s": rs["trials_per_s"],
+                        "experiments": args.shard_experiments,
+                    }), flush=True)
     if args.recovery:
         row = run_recovery()
         from metaopt_tpu.utils.provenance import provenance

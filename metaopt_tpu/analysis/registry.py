@@ -211,6 +211,11 @@ def default_race_config() -> RaceConfig:
       accuses them because the bare-name call graph resolves any
       ``x.start()`` into ``CoordServer.start`` (and ``self._wal.append``
       counts as a container write to ``_wal``).
+    * ``ShardRouter._sock`` / ``_threads`` and ``ShardSupervisor
+      ._shard_ports`` / ``shard_map`` / ``router`` / ``_watcher`` — the
+      same start()/stop() lifecycle pattern: written before the accept /
+      watcher threads exist or after they are joined; accused only via
+      the bare-name ``start()`` call-graph collapse.
     """
     rc = RaceConfig()
     rc.monitor_modules = {
@@ -219,6 +224,8 @@ def default_race_config() -> RaceConfig:
         "CoordLedgerClient": "metaopt_tpu.coord.client_backend",
         "MemoryLedger": "metaopt_tpu.ledger.backends",
         "CMAES": "metaopt_tpu.algo.cmaes",
+        "ShardRouter": "metaopt_tpu.coord.shards",
+        "ShardSupervisor": "metaopt_tpu.coord.shards",
     }
     rc.race_exempt = {
         ("CoordServer", "_mut"),
@@ -230,6 +237,12 @@ def default_race_config() -> RaceConfig:
         ("WriteAheadLog", "_appended"),
         ("WriteAheadLog", "_failed"),
         ("WriteAheadLog", "_f"),
+        ("ShardRouter", "_sock"),
+        ("ShardRouter", "_threads"),
+        ("ShardSupervisor", "_shard_ports"),
+        ("ShardSupervisor", "shard_map"),
+        ("ShardSupervisor", "router"),
+        ("ShardSupervisor", "_watcher"),
     }
     rc.entry_points = {
         # every RPC runs on a per-connection thread
@@ -238,6 +251,11 @@ def default_race_config() -> RaceConfig:
         "WriteAheadLog.append", "WriteAheadLog.sync",
         # client methods run on arbitrary worker threads
         "CoordLedgerClient.worker_cycle",
+        # router relays run on per-connection threads; the supervisor's
+        # watcher and per-shard drain threads touch the proc bookkeeping
+        "ShardRouter._serve_conn",
+        "ShardSupervisor._watch",
+        "ShardSupervisor._drain",
     }
     return rc
 
@@ -271,6 +289,8 @@ def default_config() -> LintConfig:
         "MemoryLedger": {"_lock"},
         "_ProduceCoalescer": {"_guard"},
         "SuggestAhead": {"_ahead_lock"},
+        "ShardRouter": {"_conns_lock"},
+        "ShardSupervisor": {"_procs_lock"},
     }
     cfg.lock_factories = {
         "_exp_lock": (EXP_LOCK, ["CoordServer._exp_locks_guard"]),
@@ -289,6 +309,10 @@ def default_config() -> LintConfig:
         "MemoryLedger._lock",
         "CoordLedgerClient._caps_lock",
         "CoordLedgerClient._live_lock",
+        # both guard only in-memory container snapshots; socket shutdown /
+        # proc wait / spawn all happen outside the lock
+        "ShardRouter._conns_lock",
+        "ShardSupervisor._procs_lock",
     }
     cfg.guarded_attrs = {
         "CoordServer": {
@@ -322,6 +346,25 @@ def default_config() -> LintConfig:
             "_caps": "CoordLedgerClient._caps_lock",
             "_incarnation": "CoordLedgerClient._caps_lock",
             "_live": "CoordLedgerClient._live_lock",
+            # shard-routing state learned from ping caps: the map/ring and
+            # per-address incarnations are read by every routed call and
+            # rewritten by ping/_after_reconnect on any thread
+            "_shard_map": "CoordLedgerClient._caps_lock",
+            "_ring": "CoordLedgerClient._caps_lock",
+            "_shard_addrs": "CoordLedgerClient._caps_lock",
+            "_incarnations": "CoordLedgerClient._caps_lock",
+        },
+        "ShardRouter": {
+            # live relay connections: accept thread adds, per-conn threads
+            # remove, stop() snapshots for shutdown
+            "_conns": "ShardRouter._conns_lock",
+        },
+        "ShardSupervisor": {
+            # shard bookkeeping: watcher respawns, drain threads record
+            # recovery times, chaos hooks read — all cross-thread
+            "_shards": "ShardSupervisor._procs_lock",
+            "_all_procs": "ShardSupervisor._procs_lock",
+            "recovery_times": "ShardSupervisor._procs_lock",
         },
         "MemoryLedger": {
             # ledger dicts + the O(1) status-count index

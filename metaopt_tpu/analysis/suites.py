@@ -8,7 +8,12 @@ shared state while instrumented:
   and aggressive housekeeping, under 8 client threads running the fused
   ``worker_cycle`` loop with deferred ``complete`` legs. Exercises
   accept/conn/sender threads, the sharded per-experiment locks, the
-  reply cache, group commit, and the sweep/snapshot loop.
+  reply cache, group commit, and the sweep/snapshot loop. A second
+  phase drives a 2-shard :class:`ShardSupervisor`: the shard processes
+  themselves are outside the instrumented interpreter, so the surface
+  under test is the in-process client routing state (`_ring`,
+  `_shard_addrs`, per-address incarnations under ``_caps_lock``), the
+  router's connection set, and the supervisor's proc bookkeeping.
 * ``algo`` — CMA-ES (numpy-only: no compile cost inside the detector)
   with ``suggest_prefetch_depth=2``, a driver thread running
   suggest/observe generations against the SuggestAhead refill thread,
@@ -95,6 +100,94 @@ def suite_coord(scale: int = 1) -> None:
                 t.join(timeout=120.0)
             if errors:
                 raise errors[0]
+    _coord_sharded_phase(scale)
+
+
+def _coord_sharded_phase(scale: int = 1) -> None:
+    """2-shard leg of the coord suite: worker threads route by the shard
+    map through one SHARED client (the routing table, per-address socket
+    map and incarnation dict race here), while an old-style client with
+    pinned caps drives the router fallback path concurrently."""
+    from metaopt_tpu.coord import CoordLedgerClient, ShardSupervisor
+    from metaopt_tpu.coord.shards import ring_of
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+
+    workers = 4
+    budget = workers * 3 * scale
+    with ShardSupervisor(2, restart=False) as sup:
+        host, port = sup.address
+        # one experiment per shard, so routed traffic exercises both
+        ring = ring_of(sup.shard_map)
+        names: List[str] = []
+        owners: set = set()
+        i = 0
+        while len(names) < 2:
+            nm = f"race-shard{i}"
+            if ring.owner(nm) not in owners:
+                owners.add(ring.owner(nm))
+                names.append(nm)
+            i += 1
+        shared = CoordLedgerClient(host=host, port=port)
+        shared.ping()  # learn the map before the workers fan out
+        for nm in names:
+            Experiment(
+                nm, shared,
+                space=build_space({"x": "uniform(-5, 5)"}),
+                max_trials=budget, pool_size=workers,
+                algorithm={"random": {"seed": 7}},
+            ).configure()
+        errors: List[BaseException] = []
+        stop = threading.Event()
+
+        def worker(i: int) -> None:
+            try:
+                name = names[i % len(names)]
+                for _ in range(budget * 4):
+                    out = shared.worker_cycle(
+                        name, f"sw{i}", pool_size=workers)
+                    t = out["trial"]
+                    if t is None:
+                        if out["counts"]["completed"] >= budget:
+                            return
+                        continue
+                    t.attach_results([{
+                        "name": "objective", "type": "objective",
+                        "value": (t.params["x"] - 1) ** 2,
+                    }])
+                    t.transition("completed")
+                    shared.update_trial(
+                        t, expected_status="reserved",
+                        expected_worker=f"sw{i}")
+            except BaseException as e:
+                errors.append(e)
+
+        # an old client never learns the map: every op relays through the
+        # router (pinned caps predate the shard_map capability)
+        legacy = CoordLedgerClient(host=host, port=port)
+        legacy._caps = ("count", "fetch_completed_since", "worker_cycle")
+
+        def legacy_prober() -> None:
+            try:
+                while not stop.is_set():
+                    for nm in names:
+                        legacy.count(nm, "completed")
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    name=f"race-shard-worker-{i}")
+                   for i in range(workers)]
+        threads.append(threading.Thread(target=legacy_prober,
+                                        name="race-shard-legacy"))
+        for t in threads:
+            t.start()
+        for t in threads[:-1]:
+            t.join(timeout=120.0)
+        stop.set()
+        threads[-1].join(timeout=30.0)
+        if errors:
+            raise errors[0]
 
 
 def suite_algo(scale: int = 1) -> None:

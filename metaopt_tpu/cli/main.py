@@ -285,6 +285,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="speculative pools hosted algorithms keep banked "
                           "so produce legs answer from memory (default 1 = "
                           "refill-when-stale)")
+    srv.add_argument("--shards", type=int, default=None, metavar="N",
+                     help="sharded serving: run N coordinator shard "
+                          "subprocesses (consistent-hash ownership by "
+                          "experiment, one WAL+snapshot each) behind a "
+                          "router on the public port; --snapshot then "
+                          "names a DIRECTORY (one snapshot+WAL per shard)")
 
     lint = sub.add_parser(
         "lint",
@@ -1574,6 +1580,11 @@ def _cmd_web(args, cfg: Dict[str, Any]) -> int:
 def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
     from metaopt_tpu.coord.server import CoordServer, serve_forever
 
+    coord_cfg_early = cfg.get("coordinator") or {}
+    shards = (args.shards if args.shards is not None
+              else coord_cfg_early.get("shards"))
+    if shards:
+        return _serve_sharded(args, coord_cfg_early, int(shards))
     # CLI flags > config file (`ledger:`/`coordinator:` sections) > defaults
     inner = None
     inner_spec = args.ledger
@@ -1603,6 +1614,59 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
             else coord_cfg.get("suggest_prefetch_depth", 1)),
     )
     serve_forever(server)
+    return 0
+
+
+def _serve_sharded(args, coord_cfg: Dict[str, Any], n_shards: int) -> int:
+    """``mtpu serve --shards N``: supervisor + router until SIGINT/SIGTERM.
+
+    Each shard is a subprocess CoordServer with its own snapshot + WAL
+    under the ``--snapshot`` DIRECTORY; the public port serves old
+    clients through the router while new clients learn the shard map
+    from any ping and route directly.
+    """
+    import signal
+    import threading
+
+    from metaopt_tpu.coord.shards import ShardSupervisor
+
+    if args.ledger and args.ledger != "memory":
+        print("--shards serves the in-memory inner ledger only; per-shard "
+              "durability comes from the --snapshot directory (one "
+              "snapshot+WAL per shard), not a shared file ledger",
+              file=sys.stderr)
+        return 2
+    sup = ShardSupervisor(
+        n_shards,
+        host=args.host if args.host is not None
+        else coord_cfg.get("host", "127.0.0.1"),
+        port=args.port if args.port is not None
+        else coord_cfg.get("port", 0),
+        snapshot_dir=args.snapshot_path,
+        snapshot_interval_s=args.snapshot_interval_s,
+        stale_timeout_s=args.stale_timeout_s,
+        suggest_prefetch_depth=(
+            args.suggest_prefetch_depth
+            if args.suggest_prefetch_depth is not None
+            else coord_cfg.get("suggest_prefetch_depth", 1)),
+        event_log_dir=args.event_log_path,
+    )
+    stop = threading.Event()
+    prev = signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    sup.start()
+    host, port = sup.address
+    members = ", ".join(f"{sid}=coord://{h}:{p}"
+                        for sid, (h, p) in sup.shard_addresses().items())
+    print(f"coordinator ready at coord://{host}:{port} "
+          f"({n_shards} shards: {members})", flush=True)
+    try:
+        while not stop.wait(1.0):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sup.stop()
+        signal.signal(signal.SIGTERM, prev)
     return 0
 
 

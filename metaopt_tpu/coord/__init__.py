@@ -21,9 +21,16 @@ Pieces:
   (Experiment, Producer, workon) is oblivious to the RPC hop.
 - :mod:`~metaopt_tpu.coord.pod` — ``jax.distributed`` glue: process 0 hosts
   the service, the address is agreed pod-wide.
+- :mod:`~metaopt_tpu.coord.shards` — sharded serving: N CoordServer
+  subprocesses behind one consistent-hash shard map
+  (:class:`ShardSupervisor` spawn/health-check/restart-with-recovery,
+  :class:`ShardRouter` old-client fallback proxy; new clients learn the
+  map from the ping ``caps`` and route directly).
 """
 
 from metaopt_tpu.coord.client_backend import CoordLedgerClient
 from metaopt_tpu.coord.server import CoordServer
+from metaopt_tpu.coord.shards import HashRing, ShardRouter, ShardSupervisor
 
-__all__ = ["CoordServer", "CoordLedgerClient"]
+__all__ = ["CoordServer", "CoordLedgerClient", "HashRing", "ShardRouter",
+           "ShardSupervisor"]

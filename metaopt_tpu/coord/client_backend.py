@@ -38,6 +38,12 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from metaopt_tpu.coord.protocol import ProtocolError, recv_msg, send_msg
+from metaopt_tpu.coord.shards import (
+    SHARD_MAP_CAP,
+    experiment_of,
+    ring_of,
+    shard_addrs,
+)
 from metaopt_tpu.ledger.backends import (
     DuplicateExperimentError,
     DuplicateTrialError,
@@ -116,8 +122,24 @@ class CoordLedgerClient(LedgerBackend):
         self._caps_lock = threading.Lock()
         #: server incarnation from the last ping — a reconnect that lands
         #: on a DIFFERENT incarnation crossed a restart and triggers
-        #: session resumption (re-assert reservations, re-learn caps)
+        #: session resumption (re-assert reservations, re-learn caps).
+        #: Kept as the SEED address's incarnation; sharded serving tracks
+        #: one per address in ``_incarnations`` below.
         self._incarnation: Optional[str] = None
+        #: sharded serving (coord/shards.py): when the seed's ping
+        #: advertises the "shard_map" cap, the map + ring live here (under
+        #: ``_caps_lock``) and every experiment-named op routes DIRECTLY
+        #: to the owning shard — the router hop is only for clients that
+        #: never learned the map. Against an unsharded server all three
+        #: stay empty and routing degrades to the seed address, so a new
+        #: client on an old server is wire-identical to before.
+        self._shard_map: Optional[Dict[str, Any]] = None
+        self._ring = None
+        self._shard_addrs: Dict[str, Tuple[str, int]] = {}
+        #: per-address incarnation from the last ping of THAT address —
+        #: a reconnect to one shard compares against the shard's own
+        #: identity, not the seed's
+        self._incarnations: Dict[Tuple[str, int], str] = {}
         #: reservations this client currently holds: (experiment,
         #: trial_id) → worker. Maintained by reserve/worker_cycle/
         #: update_trial/heartbeat; re-asserted after a restart so the
@@ -127,38 +149,64 @@ class CoordLedgerClient(LedgerBackend):
         self._live_lock = threading.Lock()
 
     # -- connection management --------------------------------------------
-    def _sock(self) -> socket.socket:
-        # (pid, sock) so a socket inherited across fork is never reused
-        pid_sock = getattr(self._local, "pid_sock", None)
-        if pid_sock is not None and pid_sock[0] == os.getpid():
-            return pid_sock[1]
-        s = socket.create_connection(
-            (self.host, self.port), timeout=self.connect_timeout_s
-        )
+    @property
+    def _seed(self) -> Tuple[str, int]:
+        """The configured address — router or single server; the only one
+        the client knows before a ping teaches it the shard map."""
+        return (self.host, self.port)
+
+    def _sock(self, addr: Optional[Tuple[str, int]] = None) -> socket.socket:
+        # per-(pid, thread, address): a socket inherited across fork is
+        # never reused, and a sharded map means one socket per shard
+        addr = addr or self._seed
+        socks = getattr(self._local, "pid_socks", None)
+        if socks is None or socks[0] != os.getpid():
+            socks = (os.getpid(), {})
+            self._local.pid_socks = socks
+        s = socks[1].get(addr)
+        if s is not None:
+            return s
+        s = socket.create_connection(addr, timeout=self.connect_timeout_s)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.settimeout(None)
-        self._local.pid_sock = (os.getpid(), s)
+        socks[1][addr] = s
         return s
 
-    def _drop_sock(self) -> None:
-        pid_sock = getattr(self._local, "pid_sock", None)
-        if pid_sock is not None:
-            try:
-                pid_sock[1].close()
-            except OSError:
-                pass
-        self._local.pid_sock = None
+    def _drop_sock(self, addr: Optional[Tuple[str, int]] = None) -> None:
+        addr = addr or self._seed
+        socks = getattr(self._local, "pid_socks", None)
+        if socks is not None:
+            s = socks[1].pop(addr, None)
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
 
-    def _call(self, op: str, **args: Any) -> Any:
-        # one id per logical call, shared by the retry: the server dedups on
-        # it, so "executed but reply lost" cannot double-execute the op
-        msg = {"op": op, "args": args, "req": uuid.uuid4().hex}
+    def _route(self, op: str, args: Dict[str, Any]) -> Tuple[str, int]:
+        """The address that owns this request: the owning shard when a
+        shard map is known and the op names an experiment, else the seed
+        (pan-shard ops like list_experiments/snapshot fan out there)."""
+        with self._caps_lock:
+            ring, addrs = self._ring, self._shard_addrs
+        if ring is None:
+            return self._seed
+        exp = experiment_of(op, args)
+        if exp is None:
+            return self._seed
+        return addrs.get(ring.owner(exp), self._seed)
+
+    def _exchange(self, msg: Dict[str, Any],
+                  addr: Tuple[str, int]) -> Dict[str, Any]:
+        """Send one message to ``addr`` with the reconnect-retry loop; the
+        request id inside ``msg`` is reused by every retry, so the reply
+        cache keeps non-idempotent ops exactly-once across drops."""
         deadline = time.monotonic() + self.reconnect_window_s
         attempt = 0
         delay = 0.0
         while True:
             try:
-                s = self._sock()
+                s = self._sock(addr)
                 send_msg(s, msg)
                 reply = recv_msg(s)
                 if reply is None:
@@ -166,12 +214,12 @@ class CoordLedgerClient(LedgerBackend):
                 break
             except (ConnectionError, BrokenPipeError, OSError,
                     ProtocolError) as err:  # incl. a frame cut by shutdown
-                self._drop_sock()
+                self._drop_sock(addr)
                 attempt += 1
                 if attempt >= 2:
                     if time.monotonic() >= deadline:
                         raise CoordUnavailableError(
-                            f"coordinator {self.host}:{self.port} "
+                            f"coordinator {addr[0]}:{addr[1]} "
                             f"unreachable for {self.reconnect_window_s:.0f}s"
                             f" ({type(err).__name__}: {err})"
                         ) from err
@@ -179,23 +227,64 @@ class CoordLedgerClient(LedgerBackend):
                     # a whole pod's reconnects don't land as one herd
                     delay = decorrelated_jitter(delay)
                     time.sleep(delay)
-        if attempt and op != "ping":
+        if attempt and msg.get("op") != "ping":
             # we reconnected at least once: resume the session (fresh caps,
             # and reservation re-assertion if the server incarnation
             # changed). After the reply — the retry itself was already
             # answered exactly-once by the (possibly rebuilt) reply cache.
-            self._after_reconnect()
-        if reply["ok"]:
-            return reply["result"]
+            self._after_reconnect(addr)
+        return reply
+
+    def _call(self, op: str, **args: Any) -> Any:
+        # one id per logical call, shared by the retry: the server dedups on
+        # it, so "executed but reply lost" cannot double-execute the op
+        msg = {"op": op, "args": args, "req": uuid.uuid4().hex}
+        reply: Dict[str, Any] = {}
+        for _ in range(3):
+            reply = self._exchange(msg, self._route(op, args))
+            if reply["ok"]:
+                return reply["result"]
+            if reply["error"] != "WrongShardError":
+                break
+            # stale routing table: the shard map changed under us (shard
+            # added/removed across a restart or rolling upgrade). Re-learn
+            # the map from the seed and retry — the reused request id
+            # keeps the correctly-routed retry exactly-once.
+            try:
+                self.ping()
+            except Exception:
+                log.debug("shard-map refresh ping failed", exc_info=True)
         exc = _ERRORS.get(reply["error"], CoordRPCError)
         raise exc(reply["msg"])
 
-    def ping(self) -> Dict[str, Any]:
-        r = self._call("ping")
+    def _absorb_ping(self, addr: Tuple[str, int], r: Dict[str, Any]) -> None:
+        """Record what a ping of ``addr`` taught us. Only the seed's reply
+        rewrites caps + shard map (a shard's own ping also carries them,
+        but the seed stays the single source of truth for routing)."""
         with self._caps_lock:
+            if r.get("incarnation"):
+                self._incarnations[addr] = r["incarnation"]
+            if addr != self._seed:
+                return
             self._caps = tuple(r.get("caps") or ())
             if r.get("incarnation"):
                 self._incarnation = r["incarnation"]
+            smap = r.get("shard_map")
+            if smap and SHARD_MAP_CAP in self._caps:
+                self._shard_map = smap
+                self._ring = ring_of(smap)
+                self._shard_addrs = shard_addrs(smap)
+            else:
+                # a seed that stopped advertising the cap (rolled back to
+                # a single-process server) un-teaches the map: degrade to
+                # direct seed mode rather than routing into the void
+                self._shard_map = None
+                self._ring = None
+                self._shard_addrs = {}
+
+    def ping(self) -> Dict[str, Any]:
+        r = self._call("ping")
+        self._absorb_ping(self._seed, r)
         return r
 
     # -- session resumption ------------------------------------------------
@@ -207,28 +296,42 @@ class CoordLedgerClient(LedgerBackend):
         with self._live_lock:
             self._live.pop((experiment, trial_id), None)
 
-    def _after_reconnect(self) -> None:
+    def _after_reconnect(self, addr: Optional[Tuple[str, int]] = None) -> None:
         """The client half of crash recovery, run after any reconnect.
 
-        Re-handshake: drop the cached caps and re-ping (a restarted
-        coordinator may be a different build). If the ping's
-        ``incarnation`` differs from the one we knew, this was a real
+        Re-handshake: re-ping the address we reconnected to (a restarted
+        coordinator may be a different build; a seed re-ping also
+        refreshes caps + shard map). If the ping's ``incarnation``
+        differs from the one we knew FOR THAT ADDRESS, this was a real
         restart — re-assert every reservation we hold with a heartbeat so
         the recovered server's stale sweep sees live workers, and drop
-        the ones the new server no longer honors. Guarded per-thread
-        against reentry (the resumption RPCs themselves go through
-        ``_call``) and best-effort: resumption must never turn a
-        successful retry into an error.
+        the ones the new server no longer honors. (Heartbeats route by
+        experiment, so under a shard map each lands on its owner; the
+        extra beats to shards that never restarted are no-ops.) Guarded
+        per-thread against reentry (the resumption RPCs themselves go
+        through ``_call``/``_exchange``) and best-effort: resumption must
+        never turn a successful retry into an error.
         """
+        addr = addr or self._seed
         if getattr(self._local, "resuming", False):
             return
         self._local.resuming = True
         try:
             with self._caps_lock:
-                prev = self._incarnation
-                self._caps = None  # force the re-handshake ping
+                prev = self._incarnations.get(addr)
+                if prev is None and addr == self._seed:
+                    prev = self._incarnation
+                if addr == self._seed:
+                    self._caps = None  # force the re-handshake ping
             try:
-                r = self.ping()
+                reply = self._exchange(
+                    {"op": "ping", "args": {}, "req": uuid.uuid4().hex},
+                    addr,
+                )
+                if not reply["ok"]:
+                    return
+                r = reply["result"]
+                self._absorb_ping(addr, r)
             except Exception:
                 return  # still flapping; the next call retries again
             inc = r.get("incarnation")

@@ -108,8 +108,10 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
-def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
-    """Read one framed message; None on clean EOF before a header."""
+def recv_payload(sock: socket.socket) -> Optional[bytes]:
+    """Read one framed message's raw payload bytes; None on clean EOF
+    before a header. The shard router relays replies with this — a frame
+    forwarded verbatim needs no decode+re-encode round-trip."""
     hdr = _recv_exact(sock, _HDR.size)
     if hdr is None:
         return None
@@ -119,4 +121,12 @@ def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
     payload = _recv_exact(sock, length)
     if payload is None:
         raise ProtocolError("peer closed mid-frame")
+    return payload
+
+
+def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    """Read one framed message; None on clean EOF before a header."""
+    payload = recv_payload(sock)
+    if payload is None:
+        return None
     return json.loads(payload.decode("utf-8"))

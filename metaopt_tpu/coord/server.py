@@ -82,6 +82,7 @@ from metaopt_tpu.coord.protocol import (
     send_msg,
     send_payload,
 )
+from metaopt_tpu.coord.shards import experiment_of, ring_of
 from metaopt_tpu.coord.wal import WriteAheadLog, fsync_dir, read_records
 from metaopt_tpu.executor.faults import faults
 from metaopt_tpu.ledger.backends import (
@@ -286,6 +287,8 @@ class CoordServer:
         wal: bool = True,
         wal_fsync: bool = True,
         wal_group_ms: float = 1.0,
+        shard_id: Optional[str] = None,
+        shard_map: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.inner = inner if inner is not None else MemoryLedger()
         self._bind = (host, port)
@@ -316,6 +319,19 @@ class CoordServer:
         #: incarnation knows it crossed a restart and re-asserts its live
         #: reservations / re-learns caps (session resumption)
         self._incarnation = uuid.uuid4().hex
+        #: sharded serving (metaopt_tpu/coord/shards.py): when this server
+        #: is ONE shard of a consistent-hash map, it advertises the map in
+        #: its ping reply (cap "shard_map", so new clients route directly)
+        #: and rejects experiment-named ops it does not own with
+        #: WrongShardError — a routing-table-stale client refreshes the
+        #: map and retries instead of silently splitting an experiment's
+        #: state across two shards' ledgers/WALs. Both None (the default)
+        #: = the ordinary unsharded server, wire-identical to before.
+        self.shard_id = shard_id
+        self.shard_map = shard_map
+        self._ring = (ring_of(shard_map)
+                      if shard_id is not None and shard_map is not None
+                      else None)
 
         #: global fallback lock — restore() and ops that name no experiment
         self._lock = threading.RLock()
@@ -1160,6 +1176,22 @@ class CoordServer:
         sweep.) Read ops take no server lock at all.
         """
         op = msg.get("op")
+        if self._ring is not None and op not in ("ping", "snapshot",
+                                                 "list_experiments"):
+            # sharded serving: refuse experiment-named ops this shard does
+            # not own BEFORE any cache or dispatch — accepting one would
+            # split the experiment's state across two shards' ledgers.
+            # Never cached (a stale-map retry must re-check after the
+            # client refreshes its routing table).
+            exp = experiment_of(op, msg.get("args") or {})
+            if exp is not None:
+                owner = self._ring.owner(exp)
+                if owner != self.shard_id:
+                    return {
+                        "ok": False, "error": "WrongShardError",
+                        "msg": f"experiment {exp!r} is owned by shard "
+                               f"{owner}, not {self.shard_id}",
+                    }
         if op in ("produce", "judge", "should_suspend"):
             # dispatched outside every ledger lock: an algorithm fit (TPE
             # at 10k observations takes seconds) must not stall heartbeats
@@ -1290,9 +1322,16 @@ class CoordServer:
     def _dispatch(self, op: Optional[str], a: Dict[str, Any]) -> Any:
         self._ops = next(self._op_counter)
         if op == "ping":
-            return {"pong": True, "ops": self._ops, "caps": list(CAPS),
-                    "incarnation": self._incarnation,
-                    "durable": self._wal is not None}
+            reply = {"pong": True, "ops": self._ops, "caps": list(CAPS),
+                     "incarnation": self._incarnation,
+                     "durable": self._wal is not None}
+            if self._ring is not None:
+                # sharded serving: teach the client the map so its next
+                # call routes straight to the owning shard
+                reply["caps"] = reply["caps"] + ["shard_map"]
+                reply["shard_map"] = self.shard_map
+                reply["shard_id"] = self.shard_id
+            return reply
         if op == "create_experiment":
             self.ledger.create_experiment(a["config"])
             self._event("create_experiment", a["config"].get("name"))

@@ -1,0 +1,738 @@
+"""Sharded serving: N coordinator processes behind one consistent-hash map.
+
+PR 3 measured the WAL tax on the 1-core CI box at ~30% and attributed it
+to GIL-bound wakeup scheduling, not fsync — no in-process tuning buys it
+back; only more processes can. This module escapes the single Python
+coordinator process while keeping every per-shard guarantee intact:
+
+- **Sharding unit = experiment.** Every request that names an experiment
+  (directly, via a trial doc, or via a config) is owned by exactly one
+  shard, chosen by a consistent-hash ring over the experiment id
+  (:class:`HashRing`). A shard is a full, unmodified
+  :class:`~metaopt_tpu.coord.server.CoordServer` subprocess with its OWN
+  WAL + crash-atomic snapshot + journaled reply cache, so the durability
+  and exactly-once story is per-shard verbatim — nothing is re-proved.
+- **Routing, two ways (rolling-upgrade safe both directions).** New
+  clients learn the shard map from the ``ping`` reply (cap
+  ``"shard_map"``) and route DIRECTLY to the owning shard — zero extra
+  hops on the hot path. Old clients that ignore the cap keep talking to
+  the public address, where a thin stdlib :class:`ShardRouter` process
+  decodes just enough of each frame to pick the shard, forwards the raw
+  payload, and relays the raw reply — request ids pass through
+  untouched, so the shard's journaled reply cache still gives
+  exactly-once across router-side retries.
+- **Recovery isolation.** :class:`ShardSupervisor` spawns shards as
+  subprocesses (``python -m metaopt_tpu.coord.shards``), waits for each
+  one's ``coordinator ready`` line (which doubles as the
+  recovery-complete signal — restore + WAL replay happen inside
+  ``start()``), and restarts any shard that dies on the SAME
+  snapshot/WAL paths. One shard's crash+replay never stalls the others:
+  each shard recovers in its own process while the survivors keep
+  serving, and the router retries only the dead shard's traffic inside
+  its reconnect window.
+
+The hash uses md5, not Python's builtin ``hash()`` — the builtin is
+salted per process (PYTHONHASHSEED), and a ring that two processes
+disagree on routes every request wrong.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import logging
+import os
+import signal as _signal_mod
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from metaopt_tpu.coord.protocol import (
+    ProtocolError,
+    encode_msg,
+    recv_msg,
+    recv_payload,
+    send_msg,
+    send_payload,
+)
+
+log = logging.getLogger(__name__)
+
+SHARD_MAP_VERSION = 1
+#: virtual nodes per shard on the ring — enough that a 2..16-shard map
+#: balances experiment ownership to within a few percent
+DEFAULT_VNODES = 64
+
+#: the ping cap a shard-map-aware server (or the router) advertises;
+#: clients that know it read ``shard_map`` off the ping reply and route
+#: directly, clients that don't simply keep using the address they have
+SHARD_MAP_CAP = "shard_map"
+
+
+def stable_hash(key: str) -> int:
+    """Process-independent 64-bit hash of ``key``.
+
+    Python's builtin ``hash()`` is salted per process — every router,
+    shard, and client must place an experiment at the SAME ring position,
+    so the hash has to be deterministic across processes and runs.
+    """
+    return int.from_bytes(hashlib.md5(key.encode("utf-8")).digest()[:8],
+                          "big")
+
+
+def experiment_of(op: Optional[str], args: Dict[str, Any]) -> Optional[str]:
+    """The routing key (experiment id) of one request, or None.
+
+    Mirrors ``_ShardedLedger._exp_of`` — the same derivation the server
+    uses to pick a lock picks the shard: trial-payload ops ride the
+    trial doc's ``experiment``, ``create_experiment`` the config's
+    ``name``, everything else the explicit ``experiment``/``name`` arg.
+    Requests with no key (ping, list_experiments, snapshot) are
+    pan-shard and handled by the caller.
+    """
+    exp = args.get("experiment")
+    if isinstance(exp, str):
+        return exp
+    if op == "create_experiment":
+        cfg = args.get("config") or {}
+        name = cfg.get("name")
+        return name if isinstance(name, str) else None
+    trial = args.get("trial")
+    if isinstance(trial, dict):
+        t_exp = trial.get("experiment")
+        if isinstance(t_exp, str):
+            return t_exp
+    name = args.get("name")
+    return name if isinstance(name, str) else None
+
+
+class HashRing:
+    """Consistent-hash ring: shard ids placed at ``vnodes`` points each.
+
+    ``owner(key)`` is the first point clockwise of ``hash(key)`` —
+    adding/removing one shard remaps only ~1/N of the keyspace, which is
+    what makes the stretch goal (experiment hand-off on rebalance)
+    tractable later without re-routing the world.
+    """
+
+    def __init__(self, shard_ids: List[str],
+                 vnodes: int = DEFAULT_VNODES) -> None:
+        if not shard_ids:
+            raise ValueError("hash ring needs at least one shard")
+        points = []
+        for sid in shard_ids:
+            for v in range(vnodes):
+                points.append((stable_hash(f"{sid}#{v}"), sid))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [sid for _, sid in points]
+
+    def owner(self, key: str) -> str:
+        i = bisect.bisect_right(self._hashes, stable_hash(key))
+        return self._owners[i % len(self._owners)]
+
+
+def make_shard_map(shards: List[Tuple[str, str, int]],
+                   vnodes: int = DEFAULT_VNODES) -> Dict[str, Any]:
+    """Wire-form shard map from ``[(shard_id, host, port), …]``."""
+    return {
+        "version": SHARD_MAP_VERSION,
+        "vnodes": int(vnodes),
+        "shards": [
+            {"id": sid, "host": host, "port": int(port)}
+            for sid, host, port in shards
+        ],
+    }
+
+
+def ring_of(shard_map: Dict[str, Any]) -> HashRing:
+    return HashRing([s["id"] for s in shard_map["shards"]],
+                    vnodes=int(shard_map.get("vnodes", DEFAULT_VNODES)))
+
+
+def shard_addrs(shard_map: Dict[str, Any]) -> Dict[str, Tuple[str, int]]:
+    """shard id → (host, port), in map order."""
+    return {s["id"]: (s["host"], int(s["port"]))
+            for s in shard_map["shards"]}
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# router — the old-client fallback path
+# ---------------------------------------------------------------------------
+
+class ShardRouter:
+    """Thin stdlib proxy for clients that don't speak the shard map.
+
+    Per client connection, one thread: decode the request frame (JSON —
+    only to read ``op``/``args`` for the routing key), forward the raw
+    payload to the owning shard over a per-connection upstream socket,
+    and relay the shard's raw reply bytes verbatim. No reply re-encode,
+    no state: the request id inside the payload reaches the shard
+    unmodified, so retries the router itself performs after an upstream
+    drop are answered exactly-once from the shard's journaled reply
+    cache — the router adds a hop, never a semantics change.
+
+    Pan-shard ops are the only ones the router answers itself:
+
+    - ``ping`` → forwarded to the first shard, then augmented with the
+      shard map + the ``shard_map`` cap, so even a via-router ping
+      teaches a NEW client to go direct on its next call.
+    - ``list_experiments`` → fan-out, merged + sorted.
+    - ``snapshot`` → fan-out; each shard snapshots its own configured
+      path (or ``<path>.<shard id>`` when the caller named one).
+
+    A dead upstream is retried with decorrelated jitter inside
+    ``reconnect_window_s`` (a shard restart + replay window); past it
+    the client connection is dropped and the old client's own
+    reconnect/retry logic takes over.
+    """
+
+    def __init__(self, shard_map: Dict[str, Any], host: str = "127.0.0.1",
+                 port: int = 0, reconnect_window_s: float = 30.0) -> None:
+        self.shard_map = shard_map
+        self.reconnect_window_s = reconnect_window_s
+        self._ring = ring_of(shard_map)
+        self._addrs = shard_addrs(shard_map)
+        self._first_sid = shard_map["shards"][0]["id"]
+        self._bind = (host, port)
+        self._sock: Optional[socket.socket] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._stopping = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def address(self) -> Tuple[str, int]:
+        assert self._sock is not None, "router not started"
+        return self._sock.getsockname()[:2]
+
+    def start(self) -> "ShardRouter":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(self._bind)
+        self._sock.listen(128)
+        t = threading.Thread(target=self._accept_loop,
+                             name="coord-router-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info("shard router listening on %s:%d (%d shards)",
+                 *self.address, len(self._addrs))
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._sock is not None:
+            # shutdown() before close(): same accept()-never-wakes doctrine
+            # as CoordServer.stop()
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def __enter__(self) -> "ShardRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- relay plumbing ----------------------------------------------------
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._stopping.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="coord-router-conn", daemon=True)
+            t.start()
+
+    def _connect(self, sid: str) -> socket.socket:
+        s = socket.create_connection(self._addrs[sid], timeout=10.0)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(None)
+        return s
+
+    def _forward(self, sid: str, payload: bytes,
+                 upstream: Dict[str, socket.socket]) -> bytes:
+        """Send ``payload`` to shard ``sid``; return the raw reply payload.
+
+        Retries through a shard restart: the resent payload carries the
+        SAME request id, so a mutating op that executed before the crash
+        is answered from the shard's journaled reply cache, not re-run.
+        """
+        from metaopt_tpu.coord.client_backend import decorrelated_jitter
+
+        deadline = time.monotonic() + self.reconnect_window_s
+        delay = 0.0
+        while True:
+            try:
+                s = upstream.get(sid)
+                if s is None:
+                    s = upstream[sid] = self._connect(sid)
+                send_payload(s, payload)
+                reply = recv_payload(s)
+                if reply is None:
+                    raise ConnectionError("shard closed the connection")
+                return reply
+            except (ConnectionError, BrokenPipeError, OSError,
+                    ProtocolError):
+                stale = upstream.pop(sid, None)
+                if stale is not None:
+                    try:
+                        stale.close()
+                    except OSError:
+                        pass
+                if (self._stopping.is_set()
+                        or time.monotonic() >= deadline):
+                    raise
+                delay = decorrelated_jitter(delay)
+                time.sleep(delay)
+
+    def _fanout(self, msg: Dict[str, Any],
+                upstream: Dict[str, socket.socket]) -> List[Dict[str, Any]]:
+        """One reply dict per shard, in map order; raises on dead shard."""
+        replies = []
+        for sid in self._addrs:
+            a = dict(msg.get("args") or {})
+            if msg.get("op") == "snapshot" and a.get("path"):
+                # each shard owns its own snapshot file — a shared literal
+                # path would have N processes racing one atomic rename
+                a["path"] = f"{a['path']}.{sid}"
+            replies.append(json.loads(self._forward(
+                sid, encode_msg({**msg, "args": a}), upstream)))
+        return replies
+
+    def _ping_reply(self, msg: Dict[str, Any],
+                    upstream: Dict[str, socket.socket]) -> Dict[str, Any]:
+        reply = json.loads(self._forward(
+            self._first_sid, encode_msg(msg), upstream))
+        if reply.get("ok"):
+            res = reply["result"]
+            caps = set(res.get("caps") or ())
+            caps.add(SHARD_MAP_CAP)
+            res["caps"] = sorted(caps)
+            res["shard_map"] = self.shard_map
+            # the first shard's shard_id is ITS identity, not this
+            # connection's — a routed client has no single shard
+            res.pop("shard_id", None)
+        return reply
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._conns_lock:
+            self._conns.add(conn)
+        upstream: Dict[str, socket.socket] = {}
+        try:
+            while not self._stopping.is_set():
+                try:
+                    msg = recv_msg(conn)
+                except (ProtocolError, ConnectionError, OSError,
+                        json.JSONDecodeError):
+                    return
+                if msg is None or self._stopping.is_set():
+                    return
+                op = msg.get("op")
+                try:
+                    if op == "ping":
+                        send_msg(conn, self._ping_reply(msg, upstream))
+                        continue
+                    if op == "list_experiments":
+                        replies = self._fanout(msg, upstream)
+                        bad = next(
+                            (r for r in replies if not r.get("ok")), None)
+                        if bad is None:
+                            names = sorted(
+                                {n for r in replies for n in r["result"]})
+                            send_msg(conn, {"ok": True, "result": names})
+                        else:
+                            send_msg(conn, bad)
+                        continue
+                    if op == "snapshot":
+                        replies = self._fanout(msg, upstream)
+                        bad = next(
+                            (r for r in replies if not r.get("ok")), None)
+                        if bad is None:
+                            send_msg(conn, {
+                                "ok": True,
+                                "result": ";".join(
+                                    str(r["result"]) for r in replies),
+                            })
+                        else:
+                            send_msg(conn, bad)
+                        continue
+                    exp = experiment_of(op, msg.get("args") or {})
+                    sid = (self._ring.owner(exp) if exp is not None
+                           else self._first_sid)
+                    send_payload(conn, self._forward(
+                        sid, encode_msg(msg), upstream))
+                except (ConnectionError, BrokenPipeError, OSError,
+                        ProtocolError):
+                    # upstream stayed dead past the window, or the client
+                    # side broke mid-reply: drop the connection and let
+                    # the client's own retry take over
+                    return
+        finally:
+            for s in upstream.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# supervisor — spawn, health-check, restart-with-recovery
+# ---------------------------------------------------------------------------
+
+class _ShardProc:
+    """One shard incarnation: its process + ready signal + stdout drain."""
+
+    __slots__ = ("proc", "ready", "lines", "elapsed", "t0", "reader")
+
+    def __init__(self, proc: subprocess.Popen, t0: float) -> None:
+        self.proc = proc
+        self.ready = threading.Event()
+        self.lines: List[str] = []  # pre-ready output, for spawn errors
+        self.elapsed: Optional[float] = None
+        self.t0 = t0
+        self.reader: Optional[threading.Thread] = None
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+class ShardSupervisor:
+    """Spawn/health-check/restart N CoordServer shard subprocesses.
+
+    Each shard runs ``python -m metaopt_tpu.coord.shards`` on a fixed
+    port with its own snapshot path (``shard-<i>.snap.json`` under
+    ``snapshot_dir``), so a restart lands on the same WAL + snapshot and
+    recovers exactly like the single-process crash path
+    (tests/functional/test_coord_crash.py). The ``coordinator ready``
+    stdout line doubles as the recovery-done signal; a per-shard drain
+    thread keeps consuming output afterwards so a chatty shard can never
+    block on a full pipe.
+
+    The watcher respawns any dead shard with ``METAOPT_TPU_FAULTS``
+    disarmed (a chaos fault fires once per test, same doctrine as the
+    crash-test supervisor) and never blocks on the respawn's recovery —
+    death detection stays 20 ms-granular for the OTHER shards, which is
+    what "one shard's crash+replay never stalls the others" means at the
+    supervision layer.
+
+    ``router=True`` (default) also runs a :class:`ShardRouter` on the
+    public ``(host, port)`` — the address old clients keep using; new
+    clients learn the map from any ping and go direct.
+    """
+
+    def __init__(
+        self,
+        n_shards: int,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        snapshot_dir: Optional[str] = None,
+        snapshot_interval_s: float = 30.0,
+        stale_timeout_s: Optional[float] = None,
+        router: bool = True,
+        restart: bool = True,
+        vnodes: int = DEFAULT_VNODES,
+        shard_ports: Optional[List[int]] = None,
+        shard_env: Optional[Dict[int, Dict[str, str]]] = None,
+        ready_timeout_s: float = 120.0,
+        suggest_prefetch_depth: int = 1,
+        event_log_dir: Optional[str] = None,
+        produce_coalesce_ms: Optional[float] = None,
+    ) -> None:
+        if n_shards < 1:
+            raise ValueError("need at least one shard")
+        self.n_shards = n_shards
+        self.host = host
+        self._public_port = port
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_interval_s = snapshot_interval_s
+        self.stale_timeout_s = stale_timeout_s
+        self.suggest_prefetch_depth = suggest_prefetch_depth
+        self.event_log_dir = event_log_dir
+        self.produce_coalesce_ms = produce_coalesce_ms
+        self.vnodes = vnodes
+        self.ready_timeout_s = ready_timeout_s
+        self._want_router = router
+        self._want_restart = restart
+        #: extra env per shard index, applied to the FIRST incarnation
+        #: only — the chaos test arms METAOPT_TPU_FAULTS on one shard here
+        self._shard_env = dict(shard_env or {})
+        self._shard_ports = list(shard_ports or [])
+        self.shard_map: Optional[Dict[str, Any]] = None
+        self.router: Optional[ShardRouter] = None
+        #: shard index → current incarnation; every past proc is also kept
+        #: (in _all_procs) so stop() can reap and crashes() can count
+        self._shards: Dict[int, _ShardProc] = {}
+        self._all_procs: List[subprocess.Popen] = []
+        #: wall time from each spawn to its ready line — entry 0 is the
+        #: cold start, later entries are restart+recovery times
+        self.recovery_times: List[float] = []
+        self._procs_lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def address(self) -> Tuple[str, int]:
+        """The public seed address: router if running, else shard 0."""
+        if self.router is not None:
+            return self.router.address
+        assert self.shard_map is not None, "supervisor not started"
+        return shard_addrs(self.shard_map)[self.shard_map["shards"][0]["id"]]
+
+    def shard_addresses(self) -> Dict[str, Tuple[str, int]]:
+        assert self.shard_map is not None, "supervisor not started"
+        return shard_addrs(self.shard_map)
+
+    def start(self) -> "ShardSupervisor":
+        while len(self._shard_ports) < self.n_shards:
+            self._shard_ports.append(_free_port(self.host))
+        self.shard_map = make_shard_map(
+            [(f"s{i}", self.host, self._shard_ports[i])
+             for i in range(self.n_shards)],
+            vnodes=self.vnodes,
+        )
+        # spawn all shards first, then wait: cold starts overlap. Any
+        # failure past the first spawn (a shard that never comes up, a
+        # router port already bound) must reap every child already
+        # spawned — a raised start() leaves nothing behind
+        try:
+            recs = [self._spawn(i, env_extra=self._shard_env.get(i))
+                    for i in range(self.n_shards)]
+            deadline = time.monotonic() + self.ready_timeout_s
+            for i, rec in enumerate(recs):
+                if not rec.ready.wait(max(0.0, deadline - time.monotonic())):
+                    out = "".join(rec.lines)
+                    raise RuntimeError(f"shard {i} failed to start: {out}")
+            if self._want_router:
+                self.router = ShardRouter(self.shard_map, host=self.host,
+                                          port=self._public_port).start()
+        except BaseException:
+            self.stop()
+            raise
+        if self._want_restart:
+            self._watcher = threading.Thread(
+                target=self._watch, name="coord-shard-watch", daemon=True)
+            self._watcher.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=10)
+        if self.router is not None:
+            self.router.stop()
+        with self._procs_lock:
+            procs = list(self._all_procs)
+            recs = list(self._shards.values())
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(_signal_mod.SIGTERM)  # snapshots first
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5)
+        for rec in recs:
+            if rec.reader is not None:
+                rec.reader.join(timeout=5)
+        for proc in procs:
+            if proc.stdout is not None:
+                proc.stdout.close()
+
+    def __enter__(self) -> "ShardSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- chaos hooks -------------------------------------------------------
+    def kill_shard(self, i: int) -> None:
+        """SIGKILL shard ``i``'s current incarnation (chaos tests)."""
+        with self._procs_lock:
+            proc = self._shards[i].proc
+        proc.kill()
+
+    def crashes(self) -> int:
+        with self._procs_lock:
+            procs = list(self._all_procs)
+        return sum(1 for p in procs
+                   if p.poll() == -_signal_mod.SIGKILL)
+
+    # -- spawn / watch -----------------------------------------------------
+    def _shard_argv(self, i: int) -> List[str]:
+        assert self.shard_map is not None
+        argv = [
+            sys.executable, "-m", "metaopt_tpu.coord.shards",
+            "--shard-id", f"s{i}",
+            "--host", self.host,
+            "--port", str(self._shard_ports[i]),
+            "--shard-map", json.dumps(self.shard_map,
+                                      separators=(",", ":")),
+            "--snapshot-interval-s", str(self.snapshot_interval_s),
+        ]
+        if self.snapshot_dir:
+            argv += ["--snapshot",
+                     os.path.join(self.snapshot_dir,
+                                  f"shard-{i}.snap.json")]
+        if self.stale_timeout_s is not None:
+            argv += ["--stale-timeout-s", str(self.stale_timeout_s)]
+        if self.suggest_prefetch_depth != 1:
+            argv += ["--suggest-prefetch-depth",
+                     str(self.suggest_prefetch_depth)]
+        if self.event_log_dir:
+            argv += ["--event-log",
+                     os.path.join(self.event_log_dir,
+                                  f"shard-{i}.events.jsonl")]
+        if self.produce_coalesce_ms is not None:
+            argv += ["--produce-coalesce-ms",
+                     str(self.produce_coalesce_ms)]
+        return argv
+
+    def _spawn(self, i: int, env_extra: Optional[Dict[str, str]] = None,
+               disarm: bool = False) -> _ShardProc:
+        env = dict(os.environ)
+        # the child resolves `-m metaopt_tpu.coord.shards` from the repo
+        # root whether or not the package is installed
+        root = _repo_root()
+        env["PYTHONPATH"] = (
+            root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else root
+        )
+        if env_extra:
+            env.update(env_extra)
+        if disarm:
+            # restarts run clean: an armed chaos fault fires once per
+            # incarnation, not in a crash loop
+            env.pop("METAOPT_TPU_FAULTS", None)
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            self._shard_argv(i), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        rec = _ShardProc(proc, t0)
+        rec.reader = threading.Thread(
+            target=self._drain, args=(rec,),
+            name=f"coord-shard-drain-{i}", daemon=True)
+        rec.reader.start()
+        with self._procs_lock:
+            self._shards[i] = rec
+            self._all_procs.append(proc)
+        return rec
+
+    def _drain(self, rec: _ShardProc) -> None:
+        # recovery log lines (torn-tail truncation etc.) precede the ready
+        # line on the merged pipe; after ready, keep draining so the shard
+        # never blocks on a full pipe
+        assert rec.proc.stdout is not None
+        for line in rec.proc.stdout:
+            if not rec.ready.is_set():
+                rec.lines.append(line)
+                if "coordinator ready" in line:
+                    rec.elapsed = time.monotonic() - rec.t0
+                    with self._procs_lock:
+                        self.recovery_times.append(rec.elapsed)
+                    rec.ready.set()
+
+    def _watch(self) -> None:
+        while not self._stopping.wait(0.02):
+            with self._procs_lock:
+                items = list(self._shards.items())
+            for i, rec in items:
+                if rec.proc.poll() is not None and not self._stopping.is_set():
+                    log.warning("shard %d died (rc=%s); restarting with "
+                                "recovery", i, rec.proc.returncode)
+                    # respawn is non-blocking (readiness lands via the
+                    # drain thread), so one shard's replay never delays
+                    # death detection for the others
+                    self._spawn(i, disarm=True)
+
+
+# ---------------------------------------------------------------------------
+# shard subprocess entry: python -m metaopt_tpu.coord.shards
+# ---------------------------------------------------------------------------
+
+def _shard_main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m metaopt_tpu.coord.shards",
+        description="run ONE coordinator shard (normally spawned by "
+                    "ShardSupervisor / `mtpu serve --shards N`)",
+    )
+    ap.add_argument("--shard-id", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--shard-map", default=None,
+                    help="full shard map as inline JSON")
+    ap.add_argument("--snapshot", default=None)
+    ap.add_argument("--snapshot-interval-s", type=float, default=30.0)
+    ap.add_argument("--stale-timeout-s", type=float, default=None)
+    ap.add_argument("--event-log", default=None)
+    ap.add_argument("--suggest-prefetch-depth", type=int, default=1)
+    ap.add_argument("--produce-coalesce-ms", type=float, default=None)
+    a = ap.parse_args(argv)
+
+    from metaopt_tpu.coord.server import CoordServer, serve_forever
+
+    extra: Dict[str, Any] = {}
+    if a.produce_coalesce_ms is not None:
+        extra["produce_coalesce_ms"] = a.produce_coalesce_ms
+    serve_forever(CoordServer(
+        host=a.host,
+        port=a.port,
+        snapshot_path=a.snapshot,
+        snapshot_interval_s=a.snapshot_interval_s,
+        stale_timeout_s=a.stale_timeout_s,
+        event_log_path=a.event_log,
+        suggest_prefetch_depth=a.suggest_prefetch_depth,
+        shard_id=a.shard_id,
+        shard_map=json.loads(a.shard_map) if a.shard_map else None,
+        **extra,
+    ))
+
+
+if __name__ == "__main__":
+    _shard_main()

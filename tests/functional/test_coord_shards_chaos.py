@@ -1,0 +1,146 @@
+"""Kill -9 chaos for the SHARDED coordinator: recovery isolation.
+
+One shard of a 2-shard ShardSupervisor is SIGKILLed mid-load while
+worker threads keep completing trials on BOTH shards' experiments. The
+sharding acceptance invariants (ISSUE 7):
+
+- **zero acked-write loss on the killed shard**: every completion the
+  client observed as acknowledged before the kill is present after the
+  shard restarts from its own snapshot + WAL tail;
+- **recovery isolation**: the surviving shard keeps serving during the
+  outage — its reads answer in milliseconds, not after the victim's
+  replay — because each shard owns a private WAL and recovers alone;
+- **self-healing**: the supervisor's watcher respawns the victim (with
+  chaos faults disarmed) and the full budget eventually drains.
+
+Marked ``slow``: tier-1 CI (-m 'not slow') skips these.
+"""
+
+import threading
+import time
+
+import pytest
+
+from metaopt_tpu.coord import CoordLedgerClient, ShardSupervisor
+from metaopt_tpu.coord.shards import ring_of
+from metaopt_tpu.ledger import Experiment
+from metaopt_tpu.space import build_space
+
+pytestmark = pytest.mark.slow
+
+
+def test_kill9_one_shard_zero_acked_loss_survivors_unstalled(tmp_path):
+    budget = 60  # per experiment; enough wall time to land a mid-load kill
+    with ShardSupervisor(2, snapshot_dir=str(tmp_path),
+                         snapshot_interval_s=0.5, restart=True) as sup:
+        host, port = sup.address
+        ring = ring_of(sup.shard_map)
+        # one experiment per shard; shard index 0 is the victim
+        names = {}
+        i = 0
+        while len(names) < 2:
+            nm = f"chaos-{i}"
+            names.setdefault(ring.owner(nm), nm)
+            i += 1
+        victim_exp, survivor_exp = names["s0"], names["s1"]
+
+        client = CoordLedgerClient(host=host, port=port,
+                                   reconnect_window_s=30.0)
+        client.ping()
+        assert client._ring is not None
+        for nm in names.values():
+            Experiment(
+                nm, client, space=build_space({"x": "uniform(-1, 1)"}),
+                max_trials=budget, pool_size=8,
+                algorithm={"random": {"seed": 13}},
+            ).configure()
+
+        acked_lock = threading.Lock()
+        acked = {nm: 0 for nm in names.values()}
+        errors = []
+
+        def worker(nm, w):
+            # own client per thread: a worker wedged on the dead shard
+            # must not hold up the survivor's workers
+            c = CoordLedgerClient(host=host, port=port,
+                                  reconnect_window_s=30.0)
+            try:
+                complete = None
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    out = c.worker_cycle(nm, w, pool_size=8,
+                                         complete=complete)
+                    if complete is not None:
+                        # the cycle returned → the piggybacked complete
+                        # leg was fsynced and acknowledged
+                        with acked_lock:
+                            acked[nm] += 1
+                    complete = None
+                    t = out["trial"]
+                    if t is None:
+                        if out["counts"]["completed"] >= budget:
+                            return
+                        time.sleep(0.002)
+                        continue
+                    t.attach_results([{
+                        "name": "objective", "type": "objective",
+                        "value": t.params["x"] ** 2,
+                    }])
+                    t.transition("completed")
+                    complete = {"trial": t.to_dict(),
+                                "expected_status": "reserved",
+                                "expected_worker": w}
+                raise AssertionError(f"{nm}: budget not drained")
+            except BaseException as e:  # surfaced after join
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(nm, f"cw{i}-{j}"),
+                             name=f"chaos-worker-{i}-{j}")
+            for i, nm in enumerate(names.values()) for j in range(2)
+        ]
+        for t in threads:
+            t.start()
+
+        # let both shards take acked load, then kill the victim mid-write
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with acked_lock:
+                if acked[victim_exp] >= 5 and acked[survivor_exp] >= 5:
+                    break
+            time.sleep(0.01)
+        with acked_lock:
+            acked_before_kill = acked[victim_exp]
+        assert acked_before_kill >= 5, "no acked load before the kill"
+        sup.kill_shard(0)
+
+        # recovery isolation: while the victim is down/replaying, the
+        # surviving shard answers a fresh client's read immediately
+        probe = CoordLedgerClient(host=host, port=port,
+                                   reconnect_window_s=30.0)
+        probe.ping()
+        t0 = time.monotonic()
+        probe.count(survivor_exp, "completed")
+        survivor_latency = time.monotonic() - t0
+        assert survivor_latency < 2.0, (
+            f"survivor stalled {survivor_latency:.2f}s during the "
+            "victim's outage — recovery is not isolated")
+
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "workers wedged"
+        if errors:
+            raise errors[0]
+
+        assert sup.crashes() == 1
+        # zero acked-write loss: everything acked before the kill (and
+        # after) is in the victim shard's recovered ledger
+        final = {nm: client.count(nm, "completed") for nm in names.values()}
+        assert final[victim_exp] >= acked_before_kill
+        with acked_lock:
+            for nm in names.values():
+                assert final[nm] >= acked[nm], (nm, final, acked)
+        assert final[victim_exp] == budget
+        assert final[survivor_exp] == budget
+        # the watcher timed the victim's restart (initial 2 + 1 respawn)
+        assert len(sup.recovery_times) == 3

@@ -1,0 +1,276 @@
+"""Sharded coordinator tests: hash ring, routing, rolling upgrade.
+
+The deployment doctrine under test (ARCHITECTURE.md "Sharded serving"):
+experiments are partitioned across N subprocess CoordServer shards by a
+consistent-hash ring over experiment ids; new clients learn the shard map
+from ping caps and route directly; old clients (no ``shard_map`` cap)
+fall through a stdlib router process that relays raw frames — BOTH
+directions of a rolling upgrade must keep completing trials. Crash
+recovery isolation lives in tests/functional/test_coord_shards_chaos.py.
+"""
+
+import threading
+
+import pytest
+
+from metaopt_tpu.coord import CoordLedgerClient, CoordServer, ShardSupervisor
+from metaopt_tpu.coord.shards import (
+    HashRing,
+    experiment_of,
+    make_shard_map,
+    ring_of,
+    stable_hash,
+)
+from metaopt_tpu.ledger import Experiment, Trial
+from metaopt_tpu.space import build_space
+
+
+def _client(host, port):
+    return CoordLedgerClient(host=host, port=port)
+
+
+def _two_exp_names(shard_map, prefix="sh"):
+    """One experiment name per shard, so a test exercises both."""
+    ring = ring_of(shard_map)
+    names = {}
+    i = 0
+    while len(names) < len(shard_map["shards"]):
+        nm = f"{prefix}-{i}"
+        names.setdefault(ring.owner(nm), nm)
+        i += 1
+    return names
+
+
+def _drain(client, name, budget, worker="w0", pool_size=4):
+    """Complete ``budget`` trials on ``name`` via the fused cycle."""
+    complete = None
+    for _ in range(budget * 6):
+        out = client.worker_cycle(name, worker, pool_size=pool_size,
+                                  complete=complete)
+        complete = None
+        t = out["trial"]
+        if t is None:
+            if out["counts"]["completed"] >= budget:
+                return
+            continue
+        t.attach_results([{"name": "objective", "type": "objective",
+                           "value": t.params["x"] ** 2}])
+        t.transition("completed")
+        complete = {"trial": t.to_dict(), "expected_status": "reserved",
+                    "expected_worker": worker}
+    raise AssertionError(f"{name}: budget {budget} not drained")
+
+
+class TestHashRing:
+    def test_owner_deterministic_across_instances(self):
+        # builtin hash() is salted per process; the ring must not be —
+        # every client and every shard must agree on ownership forever
+        assert stable_hash("exp-a") == stable_hash("exp-a")
+        r1 = HashRing(["s0", "s1", "s2"])
+        r2 = HashRing(["s0", "s1", "s2"])
+        for i in range(200):
+            assert r1.owner(f"e{i}") == r2.owner(f"e{i}")
+
+    def test_owner_independent_of_declaration_order(self):
+        a = HashRing(["s0", "s1", "s2"])
+        b = HashRing(["s2", "s0", "s1"])
+        for i in range(200):
+            assert a.owner(f"e{i}") == b.owner(f"e{i}")
+
+    def test_balance_within_vnode_tolerance(self):
+        ring = HashRing(["s0", "s1", "s2", "s3"])
+        per: dict = {}
+        n = 4000
+        for i in range(n):
+            sid = ring.owner(f"exp-{i}")
+            per[sid] = per.get(sid, 0) + 1
+        assert set(per) == {"s0", "s1", "s2", "s3"}
+        # 64 vnodes/shard keeps the spread well inside 2x of fair share
+        for sid, cnt in per.items():
+            assert n / 8 < cnt < n / 2, (sid, per)
+
+    def test_minimal_movement_on_shard_add(self):
+        # the consistent-hash property the design rides on: growing the
+        # ring moves only the slice the new shard takes over
+        before = HashRing(["s0", "s1", "s2"])
+        after = HashRing(["s0", "s1", "s2", "s3"])
+        keys = [f"exp-{i}" for i in range(2000)]
+        moved = sum(1 for k in keys
+                    if before.owner(k) != after.owner(k)
+                    and after.owner(k) != "s3")
+        assert moved == 0
+
+    def test_shard_map_roundtrip(self):
+        smap = make_shard_map([("s0", "127.0.0.1", 1001),
+                               ("s1", "127.0.0.1", 1002)])
+        assert smap["version"] == 1
+        ring = ring_of(smap)
+        assert ring.owner("anything") in ("s0", "s1")
+
+
+class TestExperimentOf:
+    def test_routing_key_extraction(self):
+        assert experiment_of("reserve", {"experiment": "e1"}) == "e1"
+        assert experiment_of("create_experiment",
+                             {"config": {"name": "e2"}}) == "e2"
+        assert experiment_of("register",
+                             {"trial": {"experiment": "e3"}}) == "e3"
+        assert experiment_of("load_experiment", {"name": "e4"}) == "e4"
+
+    def test_pan_shard_ops_have_no_key(self):
+        assert experiment_of("ping", {}) is None
+        assert experiment_of("list_experiments", {}) is None
+
+
+class TestShardedServing:
+    def test_new_client_routes_directly_to_both_shards(self):
+        with ShardSupervisor(2, restart=False) as sup:
+            host, port = sup.address
+            c = _client(host, port)
+            c.ping()
+            assert c._ring is not None, "shard map not learned from caps"
+            names = _two_exp_names(sup.shard_map)
+            assert len(names) == 2
+            for nm in names.values():
+                Experiment(
+                    nm, c, space=build_space({"x": "uniform(-1, 1)"}),
+                    max_trials=3, pool_size=3,
+                    algorithm={"random": {"seed": 5}},
+                ).configure()
+                _drain(c, nm, 3)
+            for nm in names.values():
+                assert c.count(nm, "completed") == 3
+            # pan-shard read merges across shards
+            listed = c.list_experiments()
+            assert set(names.values()) <= set(listed)
+
+    def test_old_client_completes_trials_through_router(self):
+        # rolling upgrade, direction 1: a pre-shard-map client pointed at
+        # the public address must keep working — the router relays every
+        # frame to the owning shard
+        with ShardSupervisor(2, restart=False) as sup:
+            host, port = sup.address
+            c = _client(host, port)
+            # pin the caps a pre-PR-7 client would have negotiated: the
+            # shard_map capability (and thus direct routing) is unknown
+            c._caps = ("count", "fetch_completed_since", "worker_cycle")
+            names = _two_exp_names(sup.shard_map, prefix="old")
+            for nm in names.values():
+                Experiment(
+                    nm, c, space=build_space({"x": "uniform(-1, 1)"}),
+                    max_trials=3, pool_size=3,
+                    algorithm={"random": {"seed": 5}},
+                ).configure()
+                _drain(c, nm, 3)
+            assert c._ring is None  # never learned the map
+            for nm in names.values():
+                assert c.count(nm, "completed") == 3
+
+    def test_new_client_degrades_against_unsharded_server(self):
+        # rolling upgrade, direction 2: a shard-aware client against a
+        # plain single-process server finds no shard_map cap and stays in
+        # direct (seed-socket) mode
+        with CoordServer() as s:
+            host, port = s.address
+            c = _client(host, port)
+            r = c.ping()
+            assert "shard_map" not in r["caps"]
+            assert c._ring is None
+            c.create_experiment({"name": "plain", "max_trials": 2})
+            c.register(Trial(params={"x": 0.5}, experiment="plain"))
+            assert c.count("plain") == 1
+
+    def test_wrong_shard_error_refreshes_map_and_retries(self):
+        # a client seeded at ONE shard's private address (stale or
+        # misconfigured bootstrap) gets WrongShardError for foreign
+        # experiments, learns the map from that shard's ping, and retries
+        # transparently to the owner
+        with ShardSupervisor(2, restart=False) as sup:
+            names = _two_exp_names(sup.shard_map, prefix="ws")
+            addrs = {s["id"]: (s["host"], s["port"])
+                     for s in sup.shard_map["shards"]}
+            (sid_a, nm_a), (sid_b, nm_b) = sorted(names.items())
+            c = _client(*addrs[sid_a])  # seeded at shard A, not router
+            # pin caps WITHOUT shard_map so the lazy caps probe does not
+            # pre-learn the map — the first B-owned op must actually take
+            # the WrongShardError → refresh → retry path
+            c._caps = ("count", "fetch_completed_since", "worker_cycle")
+            assert c._ring is None
+            c.create_experiment({"name": nm_b, "max_trials": 2})  # B-owned
+            assert c._ring is not None, "map not refreshed on WrongShard"
+            c.register(Trial(params={"x": 0.1}, experiment=nm_b))
+            assert c.count(nm_b) == 1
+            # and A-owned traffic still lands on A
+            c.create_experiment({"name": nm_a, "max_trials": 2})
+            assert c.count(nm_a) == 0
+
+    def test_shared_client_routes_concurrently(self):
+        # the routing table, per-address sockets and incarnation map are
+        # shared state: N threads drain one experiment per shard through
+        # ONE client instance
+        with ShardSupervisor(2, restart=False) as sup:
+            host, port = sup.address
+            c = _client(host, port)
+            c.ping()
+            names = list(_two_exp_names(sup.shard_map, "mt").values())
+            for nm in names:
+                Experiment(
+                    nm, c, space=build_space({"x": "uniform(-1, 1)"}),
+                    max_trials=4, pool_size=4,
+                    algorithm={"random": {"seed": 5}},
+                ).configure()
+            errors = []
+
+            def drain(nm, w):
+                try:
+                    _drain(c, nm, 4, worker=w)
+                except BaseException as e:  # surfaced below
+                    errors.append(e)
+
+            threads = [threading.Thread(target=drain, args=(nm, f"w{i}"))
+                       for i, nm in enumerate(names * 2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            if errors:
+                raise errors[0]
+            for nm in names:
+                assert c.count(nm, "completed") == 4
+
+
+class TestSupervisorLifecycle:
+    def test_failed_start_reaps_spawned_shards(self):
+        # a start() that dies AFTER spawning (here: the router's public
+        # port is already bound) must not leak shard subprocesses
+        import socket as socket_mod
+
+        blocker = socket_mod.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        try:
+            sup = ShardSupervisor(2, port=port, restart=False)
+            with pytest.raises(OSError):
+                sup.start()
+            with sup._procs_lock:
+                procs = list(sup._all_procs)
+            assert procs, "shards were never spawned — vacuous test"
+            for p in procs:
+                assert p.poll() is not None, "leaked shard subprocess"
+        finally:
+            blocker.close()
+
+
+class TestRouterFanout:
+    def test_list_experiments_merged_and_sorted(self):
+        with ShardSupervisor(2, restart=False) as sup:
+            host, port = sup.address
+            old = _client(host, port)
+            old._caps = ("count", "fetch_completed_since", "worker_cycle")
+            names = _two_exp_names(sup.shard_map, prefix="merge")
+            for nm in names.values():
+                old.create_experiment({"name": nm, "max_trials": 1})
+            listed = old.list_experiments()
+            assert set(names.values()) <= set(listed)
+            assert listed == sorted(listed)
