@@ -850,6 +850,15 @@ def main() -> None:
         coord_stats["coord_recovery_time_s"] = coord_run_recovery(
             trials=2000)["recovery_s"]
 
+        # live hand-off + failover latency on a 2-shard pod (lower is
+        # better; informational until a committed baseline carries them)
+        from benchmarks.coord_scale import run_handoff as coord_run_handoff
+
+        handoff_row = coord_run_handoff()
+        coord_stats["coord_handoff_ms"] = handoff_row["coord_handoff_ms"]
+        coord_stats["coord_failover_time_s"] = (
+            handoff_row["coord_failover_time_s"])
+
         # race-detector tax (informational, never gated): the same fused
         # path under full dynrace instrumentation — what `mtpu race
         # --suite coord` costs, paired against this run's OWN fused
@@ -1020,6 +1029,7 @@ def main() -> None:
     for key in ("coord_trials_per_s_32w", "coord_rpcs_per_trial_32w",
                 "coord_wal_overhead_pct", "coord_race_overhead_pct",
                 "coord_recovery_time_s",
+                "coord_handoff_ms", "coord_failover_time_s",
                 "coord_trials_per_s_shard1", "coord_trials_per_s_shard2",
                 "coord_trials_per_s_shard4", "coord_shard_overhead_pct",
                 "gp_suggest_ms_per_point_1k_obs",

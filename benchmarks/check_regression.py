@@ -47,6 +47,13 @@ RECOVERY_METRIC = "coord_recovery_time_s"
 SHARD_TPS_METRICS = ("coord_trials_per_s_shard1", "coord_trials_per_s_shard2",
                      "coord_trials_per_s_shard4")
 SHARD_OVERHEAD_METRIC = "coord_shard_overhead_pct"
+#: live hand-off / failover wall-clock (lower is better). Single-shot
+#: process-level latencies (fence+drain+ship / death-to-redistributed),
+#: so the slack is wider than the throughput threshold — a 20 ms figure
+#: jitters far more run-to-run than a 3-rep throughput median does.
+#: Informational until a committed baseline carries them.
+HANDOFF_METRICS = ("coord_handoff_ms", "coord_failover_time_s")
+HANDOFF_SLACK = 0.50
 #: GP-BO incremental fast path: per-point suggest latency (lower is
 #: better; the key embeds the observation count, which differs by
 #: substrate — 10k on TPU, the 1k side key on a CPU fallback — so the
@@ -183,6 +190,28 @@ def main() -> int:
     if art.get("recovery") is not None:
         print(f"{RECOVERY_METRIC}: {art['recovery']:.2f}s "
               "(informational — cold restore + WAL replay)")
+
+    # live hand-off / failover: lower is better, gated with the wider
+    # HANDOFF_SLACK against the last committed baseline carrying each
+    # metric — informational until one does
+    for mkey in HANDOFF_METRICS:
+        mval = (art.get("extra") or {}).get(mkey)
+        m_bases = [b for b in matching if b[3].get(mkey) is not None]
+        if mval is None or not m_bases:
+            print(f"{mkey}: artifact or committed baseline missing the "
+                  "metric — nothing to gate against (pass)")
+            continue
+        mb_name, _, _, mb_parsed = m_bases[-1]
+        m_base = float(mb_parsed[mkey])
+        mratio = float(mval) / m_base if m_base else 0.0
+        mverdict = (f"{mkey}: {float(mval):.3g} vs {m_base:.3g} "
+                    f"({mb_name}, {art['backend']}) → {mratio:.3f}x")
+        if m_base and mratio > 1.0 + HANDOFF_SLACK:
+            print(f"FAIL {mverdict} — hand-off latency regressed past the "
+                  f"{HANDOFF_SLACK:.0%} slack")
+            rc = 1
+        else:
+            print(f"OK {mverdict}")
 
     # sharded serving: throughputs gate inversely (higher is better) and
     # the 1-shard process tax gates with pct-point slack, each against the

@@ -394,6 +394,88 @@ def run_recovery(trials: int = 2000, seed: int = 0) -> dict:
         shutil.rmtree(wal_dir, True)
 
 
+def run_handoff(trials: int = 48, seed: int = 0) -> dict:
+    """Live hand-off + failover latency on a 2-shard pod.
+
+    ``coord_handoff_ms`` is the wall time of one `sup.handoff` of a
+    live experiment carrying ``trials`` completed trials (fence + drain
+    + capture + ship + ownership commit — the window the migrating
+    experiment's writers see ``Migrating`` retries). ``coord_failover_
+    time_s`` is the supervisor's own death-to-redistributed figure for a
+    killed shard whose experiment is recovered from snapshot+WAL on
+    disk. Both are quoted by the runbook; the regression gates stay
+    informational until a committed baseline carries them.
+    """
+    import shutil
+    import tempfile
+
+    from metaopt_tpu.coord import CoordLedgerClient
+    from metaopt_tpu.coord.shards import ShardSupervisor, ring_of
+    from metaopt_tpu.executor import InProcessExecutor
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+    from metaopt_tpu.worker import workon
+
+    snap_dir = tempfile.mkdtemp(prefix="coordscale-handoff-")
+    try:
+        with ShardSupervisor(2, snapshot_dir=snap_dir,
+                             snapshot_interval_s=0.5,
+                             failover=True) as sup:
+            host, port = sup.address
+            # a reconnect window: post-kill reads must reroute off the
+            # dead shard's address instead of failing fast
+            client = CoordLedgerClient(host=host, port=port,
+                                       reconnect_window_s=30.0)
+            client.ping()
+            # two experiments on shard s0: one to migrate live, one to
+            # leave behind for the failover kill
+            ring = ring_of(sup.shard_map)
+            names = []
+            i = 0
+            while len(names) < 2:
+                nm = f"ho-exp{i}"
+                if ring.owner(nm) == "s0":
+                    names.append(nm)
+                i += 1
+            for e, nm in enumerate(names):
+                Experiment(
+                    nm, client, space=build_space(SPACE),
+                    algorithm={"random": {"seed": seed + e}},
+                    max_trials=trials, pool_size=8,
+                ).configure()
+                workon(Experiment(nm, client).configure(),
+                       InProcessExecutor(objective),
+                       worker_id=f"ho-w{e}", producer_mode="coord",
+                       max_idle_cycles=2000, idle_sleep_s=0.002)
+
+            t0 = time.perf_counter()
+            sup.handoff(names[0], "s1")
+            handoff_s = time.perf_counter() - t0
+            moved = client.count(names[0], "completed")
+
+            # failover: kill s0 (still owning names[1]); the supervisor
+            # recovers it from disk and hands it to the survivor
+            sup.kill_shard(0)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and not sup.failover_times:
+                time.sleep(0.02)
+            if not sup.failover_times:
+                raise RuntimeError("failover never completed")
+            recovered = client.count(names[1], "completed")
+            if moved != trials or recovered != trials:
+                raise RuntimeError(
+                    f"hand-off/failover dropped trials: "
+                    f"{moved}/{recovered} of {trials}")
+            return {
+                "mode": "handoff",
+                "trials_per_experiment": trials,
+                "coord_handoff_ms": round(1e3 * handoff_s, 1),
+                "coord_failover_time_s": round(sup.failover_times[0], 3),
+            }
+    finally:
+        shutil.rmtree(snap_dir, True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workers", nargs="*", type=int, default=[1, 8, 32])
@@ -422,6 +504,11 @@ def main():
         "--recovery", action="store_true",
         help="also time crash recovery (restore + WAL replay) of a "
              "2000-trial log",
+    )
+    ap.add_argument(
+        "--handoff", action="store_true",
+        help="also time a live experiment hand-off between 2 shards and "
+             "a kill-triggered failover redistribution",
     )
     ap.add_argument("--save", action="store_true")
     args = ap.parse_args()
@@ -544,7 +631,11 @@ def main():
                     }), flush=True)
     if args.recovery:
         row = run_recovery()
-        from metaopt_tpu.utils.provenance import provenance
+        row.update(provenance())
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    if args.handoff:
+        row = run_handoff()
         row.update(provenance())
         print(json.dumps(row), flush=True)
         rows.append(row)

@@ -212,10 +212,13 @@ def default_race_config() -> RaceConfig:
       ``x.start()`` into ``CoordServer.start`` (and ``self._wal.append``
       counts as a container write to ``_wal``).
     * ``ShardRouter._sock`` / ``_threads`` and ``ShardSupervisor
-      ._shard_ports`` / ``shard_map`` / ``router`` / ``_watcher`` — the
-      same start()/stop() lifecycle pattern: written before the accept /
+      ._shard_ports`` / ``router`` / ``_watcher`` — the same
+      start()/stop() lifecycle pattern: written before the accept /
       watcher threads exist or after they are joined; accused only via
-      the bare-name ``start()`` call-graph collapse.
+      the bare-name ``start()`` call-graph collapse. (``ShardSupervisor
+      .shard_map`` left this list when hand-off/failover started
+      rewriting it from watcher/failover threads — it is now guarded by
+      ``_procs_lock`` and declared so.)
     """
     rc = RaceConfig()
     rc.monitor_modules = {
@@ -240,7 +243,6 @@ def default_race_config() -> RaceConfig:
         ("ShardRouter", "_sock"),
         ("ShardRouter", "_threads"),
         ("ShardSupervisor", "_shard_ports"),
-        ("ShardSupervisor", "shard_map"),
         ("ShardSupervisor", "router"),
         ("ShardSupervisor", "_watcher"),
     }
@@ -256,6 +258,8 @@ def default_race_config() -> RaceConfig:
         "ShardRouter._serve_conn",
         "ShardSupervisor._watch",
         "ShardSupervisor._drain",
+        # failover redistribution runs on its own per-dead-shard thread
+        "ShardSupervisor._failover_shard",
     }
     return rc
 
@@ -282,14 +286,14 @@ def default_config() -> LintConfig:
         "CoordServer": {
             "_lock", "_exp_locks_guard", "_snap_lock", "_sig_lock",
             "_replies_lock", "_inflight_lock", "_enc_lock",
-            "_producers_guard",
+            "_producers_guard", "_map_cv",
         },
         "WriteAheadLog": {"_buf_lock", "_cv"},
         "CoordLedgerClient": {"_lock", "_caps_lock", "_live_lock"},
         "MemoryLedger": {"_lock"},
         "_ProduceCoalescer": {"_guard"},
         "SuggestAhead": {"_ahead_lock"},
-        "ShardRouter": {"_conns_lock"},
+        "ShardRouter": {"_conns_lock", "_map_lock"},
         "ShardSupervisor": {"_procs_lock"},
     }
     cfg.lock_factories = {
@@ -313,11 +317,17 @@ def default_config() -> LintConfig:
         # proc wait / spawn all happen outside the lock
         "ShardRouter._conns_lock",
         "ShardSupervisor._procs_lock",
+        # routing-table swap only; connect() happens after the snapshot
+        # read releases it. (CoordServer._map_cv deliberately absent:
+        # handoff_prepare WAITS on it for the in-flight drain.)
+        "ShardRouter._map_lock",
     }
     cfg.guarded_attrs = {
         "CoordServer": {
             # reply cache (exactly-once): request-id -> reply
             "_replies": "CoordServer._replies_lock",
+            # reply→experiment attribution (shipped with a hand-off)
+            "_reply_exps": "CoordServer._replies_lock",
             "_exp_locks": "CoordServer._exp_locks_guard",
             "_signals": "CoordServer._sig_lock",
             "_inflight": "CoordServer._inflight_lock",
@@ -328,6 +338,13 @@ def default_config() -> LintConfig:
             # per-experiment mutation counters for the delta-read path;
             # written only while holding the experiment's lock
             "_mut": EXP_LOCK,
+            # hand-off plane: the migration fence, the per-experiment
+            # in-flight counts the drain waits on, and the shard map /
+            # routing table the ownership commit swaps
+            "_migrating": "CoordServer._map_cv",
+            "_exp_inflight": "CoordServer._map_cv",
+            "shard_map": "CoordServer._map_cv",
+            "_ring": "CoordServer._map_cv",
         },
         "WriteAheadLog": {
             "_pending": "WriteAheadLog._buf_lock",
@@ -341,6 +358,9 @@ def default_config() -> LintConfig:
             # batch/record telemetry incremented per group commit
             "batches": "WriteAheadLog._buf_lock",
             "records": "WriteAheadLog._buf_lock",
+            # open compaction fences (hand-off tail extraction): compact()
+            # polls it under the cv exactly like _syncing
+            "_fence": "WriteAheadLog._cv",
         },
         "CoordLedgerClient": {
             "_caps": "CoordLedgerClient._caps_lock",
@@ -353,11 +373,20 @@ def default_config() -> LintConfig:
             "_ring": "CoordLedgerClient._caps_lock",
             "_shard_addrs": "CoordLedgerClient._caps_lock",
             "_incarnations": "CoordLedgerClient._caps_lock",
+            # monotonic map-adoption watermark: a stale lower-version
+            # ping can never roll the routing back
+            "_map_version": "CoordLedgerClient._caps_lock",
         },
         "ShardRouter": {
             # live relay connections: accept thread adds, per-conn threads
             # remove, stop() snapshots for shutdown
             "_conns": "ShardRouter._conns_lock",
+            # routing state swapped whole by update_map() (hand-off /
+            # failover commits race the per-connection relay threads)
+            "shard_map": "ShardRouter._map_lock",
+            "_table": "ShardRouter._map_lock",
+            "_addrs": "ShardRouter._map_lock",
+            "_first_sid": "ShardRouter._map_lock",
         },
         "ShardSupervisor": {
             # shard bookkeeping: watcher respawns, drain threads record
@@ -365,6 +394,11 @@ def default_config() -> LintConfig:
             "_shards": "ShardSupervisor._procs_lock",
             "_all_procs": "ShardSupervisor._procs_lock",
             "recovery_times": "ShardSupervisor._procs_lock",
+            # hand-off/failover: the committed map and the failover
+            # telemetry are rewritten from failover threads
+            "shard_map": "ShardSupervisor._procs_lock",
+            "failover_times": "ShardSupervisor._procs_lock",
+            "_failover_threads": "ShardSupervisor._procs_lock",
         },
         "MemoryLedger": {
             # ledger dicts + the O(1) status-count index

@@ -13,7 +13,11 @@ shared state while instrumented:
   themselves are outside the instrumented interpreter, so the surface
   under test is the in-process client routing state (`_ring`,
   `_shard_addrs`, per-address incarnations under ``_caps_lock``), the
-  router's connection set, and the supervisor's proc bookkeeping.
+  router's connection set, and the supervisor's proc bookkeeping. A
+  third phase bounces a live experiment between two shards with
+  ``sup.handoff`` under concurrent writers: the client's monotonic map
+  adoption + ``Migrating`` retry loop, the router's table swap under
+  ``_map_lock``, and the supervisor's committed-map bookkeeping race.
 * ``algo`` — CMA-ES (numpy-only: no compile cost inside the detector)
   with ``suggest_prefetch_depth=2``, a driver thread running
   suggest/observe generations against the SuggestAhead refill thread,
@@ -101,6 +105,7 @@ def suite_coord(scale: int = 1) -> None:
             if errors:
                 raise errors[0]
     _coord_sharded_phase(scale)
+    _coord_handoff_phase(scale)
 
 
 def _coord_sharded_phase(scale: int = 1) -> None:
@@ -188,6 +193,81 @@ def _coord_sharded_phase(scale: int = 1) -> None:
         threads[-1].join(timeout=30.0)
         if errors:
             raise errors[0]
+
+
+def _coord_handoff_phase(scale: int = 1) -> None:
+    """Live-migration leg of the coord suite: worker threads hammer ONE
+    experiment through a shared routed client while the main thread
+    bounces it between the two shards with ``sup.handoff``. The shard
+    processes are uninstrumented; the surface under test is the client's
+    monotonic map adoption (``_map_version`` under ``_caps_lock``), its
+    ``Migrating``/``WrongShardError`` retry loop, the router's routing
+    table swap under ``_map_lock``, and the supervisor's committed map +
+    override bookkeeping under ``_procs_lock``."""
+    from metaopt_tpu.coord import CoordLedgerClient, ShardSupervisor
+    from metaopt_tpu.coord.shards import RoutingTable
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+
+    workers = 4
+    budget = workers * 3 * scale
+    with tempfile.TemporaryDirectory() as td:
+        with ShardSupervisor(2, restart=False,
+                             snapshot_dir=os.path.join(td, "snaps")) as sup:
+            host, port = sup.address
+            nm = "race-handoff"
+            shared = CoordLedgerClient(host=host, port=port)
+            shared.ping()  # learn the map before the workers fan out
+            Experiment(
+                nm, shared,
+                space=build_space({"x": "uniform(-5, 5)"}),
+                max_trials=budget, pool_size=workers,
+                algorithm={"random": {"seed": 7}},
+            ).configure()
+            errors: List[BaseException] = []
+
+            def worker(i: int) -> None:
+                try:
+                    for _ in range(budget * 6):
+                        out = shared.worker_cycle(
+                            nm, f"hw{i}", pool_size=workers)
+                        t = out["trial"]
+                        if t is None:
+                            if out["counts"]["completed"] >= budget:
+                                return
+                            continue
+                        t.attach_results([{
+                            "name": "objective", "type": "objective",
+                            "value": (t.params["x"] - 1) ** 2,
+                        }])
+                        t.transition("completed")
+                        shared.update_trial(
+                            t, expected_status="reserved",
+                            expected_worker=f"hw{i}")
+                except BaseException as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        name=f"race-handoff-worker-{i}")
+                       for i in range(workers)]
+            for t in threads:
+                t.start()
+            try:
+                # bounce the experiment source→dest→source while the
+                # workers write through the migration fence
+                sids = [s["id"] for s in sup.shard_map["shards"]]
+                src = RoutingTable(sup.shard_map).owner(nm)
+                dst = next(s for s in sids if s != src)
+                for dest in (dst, src):
+                    sup.handoff(nm, dest, drain_timeout_s=10.0,
+                                window_s=30.0)
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                for t in threads:
+                    t.join(timeout=120.0)
+            if errors:
+                raise errors[0]
 
 
 def suite_algo(scale: int = 1) -> None:

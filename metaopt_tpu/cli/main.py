@@ -292,6 +292,26 @@ def build_parser() -> argparse.ArgumentParser:
                           "router on the public port; --snapshot then "
                           "names a DIRECTORY (one snapshot+WAL per shard)")
 
+    reb = sub.add_parser(
+        "rebalance",
+        help="live-migrate one experiment to another coordinator shard "
+             "(zero acked-write loss; see ARCHITECTURE.md hand-off "
+             "protocol)",
+    )
+    reb.add_argument("--coord", required=True, metavar="HOST:PORT",
+                     help="any address of the sharded deployment (the "
+                          "public/router address or any shard) — the "
+                          "shard map is learned from its ping")
+    reb.add_argument("--experiment", required=True,
+                     help="experiment to move")
+    reb.add_argument("--dest", required=True, metavar="SHARD_ID",
+                     help="destination shard id (e.g. s1)")
+    reb.add_argument("--drain-timeout-s", type=float, default=10.0,
+                     help="max wait for the experiment's in-flight ops "
+                          "to drain on the source")
+    reb.add_argument("--window-s", type=float, default=30.0,
+                     help="per-step retry window through shard restarts")
+
     lint = sub.add_parser(
         "lint",
         help="repo-invariant static analysis (lock discipline, JAX "
@@ -1670,6 +1690,65 @@ def _serve_sharded(args, coord_cfg: Dict[str, Any], n_shards: int) -> int:
     return 0
 
 
+def _cmd_rebalance(args, cfg: Dict[str, Any]) -> int:
+    """``mtpu rebalance``: live-migrate one experiment between shards.
+
+    Learns the shard map from any address's ping, computes the
+    version-bumped map pinning the experiment to ``--dest``, and drives
+    the prepare→ship→apply→commit protocol from this process — the same
+    primitive supervisor failover uses (ARCHITECTURE.md "Hand-off &
+    failover").
+    """
+    from metaopt_tpu.coord.handoff import (
+        HandoffError, call_admin, migrate_experiment,
+    )
+    from metaopt_tpu.coord.shards import RoutingTable, with_override
+
+    host, _, port = args.coord.rpartition(":")
+    if not host or not port.isdigit():
+        print(f"--coord must be HOST:PORT, got {args.coord!r}",
+              file=sys.stderr)
+        return 2
+    seed = (host, int(port))
+    try:
+        reply = call_admin(seed, "ping", {}, window_s=args.window_s)
+    except HandoffError as err:
+        print(err, file=sys.stderr)
+        return 1
+    smap = (reply.get("result") or {}).get("shard_map") \
+        if reply.get("ok") else None
+    if not smap:
+        print(f"{args.coord} does not advertise a shard map — not a "
+              "sharded deployment?", file=sys.stderr)
+        return 2
+    table = RoutingTable(smap)
+    if args.dest not in table.addrs:
+        print(f"unknown destination shard {args.dest!r}; map has: "
+              f"{', '.join(sorted(table.addrs))}", file=sys.stderr)
+        return 2
+    source = table.owner(args.experiment)
+    if source == args.dest:
+        print(f"{args.experiment} already lives on {args.dest}; nothing "
+              "to do")
+        return 0
+    new_map = with_override(smap, args.experiment, args.dest)
+    try:
+        result = migrate_experiment(
+            args.experiment, table.addrs[source], table.addrs[args.dest],
+            args.dest, new_map,
+            other_addrs=[a for sid, a in table.addrs.items()
+                         if sid not in (source, args.dest)],
+            drain_timeout_s=args.drain_timeout_s, window_s=args.window_s)
+    except HandoffError as err:
+        print(f"rebalance failed: {err}", file=sys.stderr)
+        return 1
+    print(f"{args.experiment}: {source} -> {args.dest} "
+          f"({result.get('trials', 0)} trials, "
+          f"{result.get('replies', 0)} cached replies, "
+          f"map v{result.get('map_version')})")
+    return 0
+
+
 def _cmd_benchmark(args, cfg) -> int:
     """Run one study (task × assessment) across the requested algorithms."""
     from metaopt_tpu.benchmark import (
@@ -1800,6 +1879,7 @@ _COMMANDS = {
     "plot": _cmd_plot,
     "resume": _cmd_resume,
     "status": _cmd_status,
+    "rebalance": _cmd_rebalance,
     "serve": _cmd_serve,
     "web": _cmd_web,
 }

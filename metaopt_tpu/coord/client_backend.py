@@ -40,9 +40,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from metaopt_tpu.coord.protocol import ProtocolError, recv_msg, send_msg
 from metaopt_tpu.coord.shards import (
     SHARD_MAP_CAP,
+    RoutingTable,
     experiment_of,
-    ring_of,
-    shard_addrs,
+    map_version,
 )
 from metaopt_tpu.ledger.backends import (
     DuplicateExperimentError,
@@ -136,6 +136,12 @@ class CoordLedgerClient(LedgerBackend):
         self._shard_map: Optional[Dict[str, Any]] = None
         self._ring = None
         self._shard_addrs: Dict[str, Tuple[str, int]] = {}
+        #: version of the adopted map — adoption is MONOTONIC: a stale
+        #: ping reply (raced with a hand-off commit) carrying a LOWER
+        #: version must never roll routing back to the pre-migration
+        #: owner, or acked writes would land on a shard about to drop
+        #: the experiment. -1 = no map adopted yet.
+        self._map_version: int = -1
         #: per-address incarnation from the last ping of THAT address —
         #: a reconnect to one shard compares against the shard's own
         #: identity, not the seed's
@@ -227,6 +233,26 @@ class CoordLedgerClient(LedgerBackend):
                     # a whole pod's reconnects don't land as one herd
                     delay = decorrelated_jitter(delay)
                     time.sleep(delay)
+                    if addr != self._seed and not getattr(
+                            self._local, "rerouting", False):
+                        # the owning shard may be GONE for good (failover
+                        # shrank the map): re-learn routing from the seed
+                        # and follow the new owner instead of dialing a
+                        # dead address for the whole window
+                        self._local.rerouting = True
+                        try:
+                            self.ping()
+                        except Exception:
+                            log.debug("reroute ping failed", exc_info=True)
+                        finally:
+                            self._local.rerouting = False
+                        new_addr = self._route(msg.get("op"),
+                                               msg.get("args") or {})
+                        if new_addr != addr:
+                            log.info("rerouting %s from %s to %s after "
+                                     "map refresh", msg.get("op"), addr,
+                                     new_addr)
+                            addr = new_addr
         if attempt and msg.get("op") != "ping":
             # we reconnected at least once: resume the session (fresh caps,
             # and reservation re-assertion if the server incarnation
@@ -239,17 +265,30 @@ class CoordLedgerClient(LedgerBackend):
         # one id per logical call, shared by the retry: the server dedups on
         # it, so "executed but reply lost" cannot double-execute the op
         msg = {"op": op, "args": args, "req": uuid.uuid4().hex}
-        reply: Dict[str, Any] = {}
-        for _ in range(3):
+        # Migrating = the owning shard fenced this experiment for a live
+        # hand-off; the fence lifts (→ success on the new owner, or
+        # WrongShardError pointing there) within the migration window, so
+        # wait it out rather than failing a healthy pod
+        deadline = time.monotonic() + max(5.0, self.reconnect_window_s)
+        misses = 0
+        delay = 0.0
+        while True:
             reply = self._exchange(msg, self._route(op, args))
             if reply["ok"]:
                 return reply["result"]
-            if reply["error"] != "WrongShardError":
+            err = reply["error"]
+            if err == "WrongShardError" and misses < 2:
+                # stale routing table: the shard map changed under us
+                # (hand-off commit, shard added/removed across a restart
+                # or rolling upgrade). Re-learn the map from the seed and
+                # retry — the reused request id keeps the correctly-routed
+                # retry exactly-once.
+                misses += 1
+            elif err == "Migrating" and time.monotonic() < deadline:
+                delay = decorrelated_jitter(delay)
+                time.sleep(delay)
+            else:
                 break
-            # stale routing table: the shard map changed under us (shard
-            # added/removed across a restart or rolling upgrade). Re-learn
-            # the map from the seed and retry — the reused request id
-            # keeps the correctly-routed retry exactly-once.
             try:
                 self.ping()
             except Exception:
@@ -271,9 +310,14 @@ class CoordLedgerClient(LedgerBackend):
                 self._incarnation = r["incarnation"]
             smap = r.get("shard_map")
             if smap and SHARD_MAP_CAP in self._caps:
-                self._shard_map = smap
-                self._ring = ring_of(smap)
-                self._shard_addrs = shard_addrs(smap)
+                if map_version(smap) >= self._map_version:
+                    table = RoutingTable(smap)
+                    self._shard_map = smap
+                    self._ring = table
+                    self._shard_addrs = table.addrs
+                    self._map_version = table.version
+                # else: stale reply from before a hand-off commit —
+                # keep the newer routing (monotonic adoption)
             else:
                 # a seed that stopped advertising the cap (rolled back to
                 # a single-process server) un-teaches the map: degrade to
@@ -281,6 +325,7 @@ class CoordLedgerClient(LedgerBackend):
                 self._shard_map = None
                 self._ring = None
                 self._shard_addrs = {}
+                self._map_version = -1
 
     def ping(self) -> Dict[str, Any]:
         r = self._call("ping")

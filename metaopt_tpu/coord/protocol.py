@@ -73,6 +73,15 @@ REPLY_JOURNALED_OPS = frozenset({"worker_cycle"})
 #: ops that mutate only through nested ledger calls, each of which
 #: journals itself inside the sharded proxy
 NESTED_JOURNALED_OPS = frozenset({"produce"})
+#
+# Deliberately absent: the hand-off admin plane (``handoff_prepare`` /
+# ``handoff_apply`` / ``handoff_abort`` / ``shard_map_update``). Those ops
+# are handled in ``CoordServer._handle`` (not ``_dispatch``), journal
+# inside their own handlers, and are idempotent rather than reply-cached —
+# declaring them in JOURNALED_OPS would make MTD001 look for a dispatch
+# branch that intentionally does not exist. They ARE members of the
+# server's ``_DURABLE_OPS`` (a strict superset of these registries), so
+# their replies still wait on the fsync barrier.
 
 
 class ProtocolError(RuntimeError):

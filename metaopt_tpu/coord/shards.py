@@ -160,6 +160,70 @@ def shard_addrs(shard_map: Dict[str, Any]) -> Dict[str, Tuple[str, int]]:
             for s in shard_map["shards"]}
 
 
+class RoutingTable:
+    """Overrides-aware routing view of ONE shard-map version.
+
+    A hand-off pins the moved experiment to its new owner via the map's
+    ``overrides`` dict (experiment → shard id) so the move does not have
+    to wait for ring churn; the ring stays the default for every
+    un-pinned key. ``owner()`` keeps the HashRing signature, so every
+    caller that used to hold a ring can hold a table instead.
+    """
+
+    def __init__(self, shard_map: Dict[str, Any]) -> None:
+        self.shard_map = shard_map
+        self.version = int(shard_map.get("version", 0))
+        self.overrides: Dict[str, str] = dict(
+            shard_map.get("overrides") or {})
+        self.addrs = shard_addrs(shard_map)
+        self._ring = ring_of(shard_map)
+
+    def owner(self, key: str) -> str:
+        sid = self.overrides.get(key)
+        return sid if sid is not None else self._ring.owner(key)
+
+
+def map_version(shard_map: Optional[Dict[str, Any]]) -> int:
+    return int(shard_map.get("version", 0)) if shard_map else -1
+
+
+def with_override(shard_map: Dict[str, Any], experiment: str,
+                  dest_sid: str) -> Dict[str, Any]:
+    """A version-bumped copy of the map pinning ``experiment`` to
+    ``dest_sid`` (or un-pinning it when that is its natural ring owner)."""
+    if dest_sid not in shard_addrs(shard_map):
+        raise ValueError(f"unknown destination shard {dest_sid!r}")
+    new = json.loads(json.dumps(shard_map))
+    overrides = dict(new.get("overrides") or {})
+    if ring_of(new).owner(experiment) == dest_sid:
+        overrides.pop(experiment, None)
+    else:
+        overrides[experiment] = dest_sid
+    new["overrides"] = overrides
+    new["version"] = map_version(shard_map) + 1
+    return new
+
+
+def without_shard(shard_map: Dict[str, Any], dead_sid: str
+                  ) -> Dict[str, Any]:
+    """A version-bumped copy of the map with ``dead_sid`` removed.
+
+    Overrides that pinned experiments to the dead shard are dropped —
+    the shrunken ring's natural owner (always a survivor) takes over;
+    survivors' own keys don't move, that is the point of the
+    consistent hash.
+    """
+    new = json.loads(json.dumps(shard_map))
+    new["shards"] = [s for s in new["shards"] if s["id"] != dead_sid]
+    if not new["shards"]:
+        raise ValueError("cannot remove the last shard from the map")
+    new["overrides"] = {e: s
+                       for e, s in (new.get("overrides") or {}).items()
+                       if s != dead_sid}
+    new["version"] = map_version(shard_map) + 1
+    return new
+
+
 def _free_port(host: str = "127.0.0.1") -> int:
     with socket.socket() as s:
         s.bind((host, 0))
@@ -201,7 +265,11 @@ class ShardRouter:
                  port: int = 0, reconnect_window_s: float = 30.0) -> None:
         self.shard_map = shard_map
         self.reconnect_window_s = reconnect_window_s
-        self._ring = ring_of(shard_map)
+        #: routing state (shard_map/_table/_addrs/_first_sid) is read per
+        #: request and replaced wholesale by update_map() after a
+        #: hand-off/failover — all of it lives under _map_lock
+        self._map_lock = threading.Lock()
+        self._table = RoutingTable(shard_map)
         self._addrs = shard_addrs(shard_map)
         self._first_sid = shard_map["shards"][0]["id"]
         self._bind = (host, port)
@@ -263,6 +331,50 @@ class ShardRouter:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # -- map churn ---------------------------------------------------------
+    def update_map(self, new_map: Dict[str, Any]) -> bool:
+        """Adopt ``new_map`` iff its version is strictly newer.
+
+        Called by the supervisor after a hand-off/failover commit and by
+        the relay path itself when a shard's reply reveals a newer map.
+        Monotonic: a stale lower-version map (a slow pre-migration ping
+        racing the commit) can never roll the routing table back.
+        """
+        with self._map_lock:
+            if map_version(new_map) <= self._table.version:
+                return False
+            self.shard_map = new_map
+            self._table = RoutingTable(new_map)
+            self._addrs = shard_addrs(new_map)
+            self._first_sid = new_map["shards"][0]["id"]
+        log.info("router adopted shard map v%d (%d shards, %d overrides)",
+                 map_version(new_map), len(new_map["shards"]),
+                 len(new_map.get("overrides") or {}))
+        return True
+
+    def _refresh_map(self, sid: str,
+                     upstream: Dict[str, socket.socket]) -> None:
+        """Best-effort: ping shard ``sid`` and adopt any newer map it
+        advertises (post-commit, the migration source/survivors all carry
+        the bumped map)."""
+        try:
+            reply = json.loads(self._forward(
+                sid, encode_msg({"op": "ping", "args": {}}), upstream))
+            smap = (reply.get("result") or {}).get("shard_map") \
+                if reply.get("ok") else None
+            if smap:
+                self.update_map(smap)
+        except (ConnectionError, BrokenPipeError, OSError, ProtocolError,
+                json.JSONDecodeError, KeyError):
+            log.debug("router map refresh via %s failed", sid,
+                      exc_info=True)
+
+    @staticmethod
+    def _routing_miss(reply: Dict[str, Any]) -> bool:
+        """True for the two retryable mid-migration answers."""
+        return (not reply.get("ok")
+                and reply.get("error") in ("WrongShardError", "Migrating"))
+
     # -- relay plumbing ----------------------------------------------------
     def _accept_loop(self) -> None:
         assert self._sock is not None
@@ -276,7 +388,9 @@ class ShardRouter:
             t.start()
 
     def _connect(self, sid: str) -> socket.socket:
-        s = socket.create_connection(self._addrs[sid], timeout=10.0)
+        with self._map_lock:
+            addr = self._addrs[sid]
+        s = socket.create_connection(addr, timeout=10.0)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.settimeout(None)
         return s
@@ -319,32 +433,114 @@ class ShardRouter:
 
     def _fanout(self, msg: Dict[str, Any],
                 upstream: Dict[str, socket.socket]) -> List[Dict[str, Any]]:
-        """One reply dict per shard, in map order; raises on dead shard."""
-        replies = []
-        for sid in self._addrs:
-            a = dict(msg.get("args") or {})
-            if msg.get("op") == "snapshot" and a.get("path"):
-                # each shard owns its own snapshot file — a shared literal
-                # path would have N processes racing one atomic rename
-                a["path"] = f"{a['path']}.{sid}"
-            replies.append(json.loads(self._forward(
-                sid, encode_msg({**msg, "args": a}), upstream)))
-        return replies
+        """One reply dict per shard, in map order; raises on dead shard.
+
+        A shard that answers ``WrongShardError``/``Migrating`` is
+        mid-hand-off, not broken: refresh the map from it and re-run the
+        fan-out against the (possibly newer) shard set instead of
+        surfacing a transient routing error to an old client.
+        """
+        from metaopt_tpu.coord.client_backend import decorrelated_jitter
+
+        deadline = time.monotonic() + self.reconnect_window_s
+        delay = 0.0
+        while True:
+            with self._map_lock:
+                sids = list(self._addrs)
+            replies = []
+            stale_sid = None
+            for sid in sids:
+                a = dict(msg.get("args") or {})
+                if msg.get("op") == "snapshot" and a.get("path"):
+                    # each shard owns its own snapshot file — a shared
+                    # literal path would have N processes racing one
+                    # atomic rename
+                    a["path"] = f"{a['path']}.{sid}"
+                try:
+                    r = json.loads(self._forward(
+                        sid, encode_msg({**msg, "args": a}), upstream))
+                except KeyError:
+                    # the sid left the map mid-fan-out (failover shrank
+                    # the ring): re-run against the current shard set
+                    stale_sid = sid
+                    replies = None
+                    break
+                if self._routing_miss(r):
+                    stale_sid = sid
+                replies.append(r)
+            if replies is not None and (stale_sid is None
+                                        or time.monotonic() >= deadline):
+                return replies
+            self._refresh_map(stale_sid, upstream)
+            delay = decorrelated_jitter(delay)
+            time.sleep(delay)
 
     def _ping_reply(self, msg: Dict[str, Any],
                     upstream: Dict[str, socket.socket]) -> Dict[str, Any]:
+        with self._map_lock:
+            first_sid = self._first_sid
         reply = json.loads(self._forward(
-            self._first_sid, encode_msg(msg), upstream))
+            first_sid, encode_msg(msg), upstream))
         if reply.get("ok"):
             res = reply["result"]
+            # a post-hand-off shard may advertise a newer map than the
+            # router has seen — adopt it before echoing a map back
+            smap = res.get("shard_map")
+            if smap:
+                self.update_map(smap)
             caps = set(res.get("caps") or ())
             caps.add(SHARD_MAP_CAP)
             res["caps"] = sorted(caps)
-            res["shard_map"] = self.shard_map
+            with self._map_lock:
+                res["shard_map"] = self.shard_map
             # the first shard's shard_id is ITS identity, not this
             # connection's — a routed client has no single shard
             res.pop("shard_id", None)
         return reply
+
+    def _relay(self, conn: socket.socket, msg: Dict[str, Any],
+               upstream: Dict[str, socket.socket]) -> None:
+        """Forward one experiment-keyed request, chasing a live hand-off.
+
+        ``Migrating`` means the owner is quiescing the experiment (retry
+        the same shard until the commit lands); ``WrongShardError`` means
+        ownership already moved (refresh the map and follow it). Past the
+        window the last reply — whatever it was — is surfaced.
+        """
+        from metaopt_tpu.coord.client_backend import decorrelated_jitter
+
+        exp = experiment_of(msg.get("op"), msg.get("args") or {})
+        payload = encode_msg(msg)
+        deadline = time.monotonic() + self.reconnect_window_s
+        delay = 0.0
+        while True:
+            with self._map_lock:
+                sid = (self._table.owner(exp) if exp is not None
+                       else self._first_sid)
+            try:
+                raw = self._forward(sid, payload, upstream)
+            except KeyError:
+                # the owner left the map mid-forward (failover shrank the
+                # ring under a connect retry): re-resolve against the new
+                # table — the shrunken ring names a survivor
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(f"shard {sid} left the map")
+                delay = decorrelated_jitter(delay)
+                time.sleep(delay)
+                continue
+            # cheap sniff before a JSON parse: routing misses are tiny
+            # error frames, hot replies pass through untouched
+            if (exp is not None and len(raw) < 512
+                    and (b"WrongShardError" in raw or b"Migrating" in raw)):
+                reply = json.loads(raw)
+                if self._routing_miss(reply) \
+                        and time.monotonic() < deadline:
+                    self._refresh_map(sid, upstream)
+                    delay = decorrelated_jitter(delay)
+                    time.sleep(delay)
+                    continue
+            send_payload(conn, raw)
+            return
 
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -389,13 +585,9 @@ class ShardRouter:
                         else:
                             send_msg(conn, bad)
                         continue
-                    exp = experiment_of(op, msg.get("args") or {})
-                    sid = (self._ring.owner(exp) if exp is not None
-                           else self._first_sid)
-                    send_payload(conn, self._forward(
-                        sid, encode_msg(msg), upstream))
+                    self._relay(conn, msg, upstream)
                 except (ConnectionError, BrokenPipeError, OSError,
-                        ProtocolError):
+                        ProtocolError, KeyError):
                     # upstream stayed dead past the window, or the client
                     # side broke mid-reply: drop the connection and let
                     # the client's own retry take over
@@ -459,6 +651,14 @@ class ShardSupervisor:
     ``router=True`` (default) also runs a :class:`ShardRouter` on the
     public ``(host, port)`` — the address old clients keep using; new
     clients learn the map from any ping and go direct.
+
+    ``failover=True`` changes what death means: instead of respawning
+    the dead shard, its experiments are recovered from its snapshot+WAL
+    on disk and handed to the SURVIVORS via the live hand-off protocol
+    (:mod:`metaopt_tpu.coord.handoff`), shrinking the ring; survivors
+    keep answering their own traffic throughout, and the wall time of
+    each redistribution lands in ``failover_times``. ``handoff()`` runs
+    the same protocol on demand for live rebalancing (`mtpu rebalance`).
     """
 
     def __init__(
@@ -471,6 +671,7 @@ class ShardSupervisor:
         stale_timeout_s: Optional[float] = None,
         router: bool = True,
         restart: bool = True,
+        failover: bool = False,
         vnodes: int = DEFAULT_VNODES,
         shard_ports: Optional[List[int]] = None,
         shard_env: Optional[Dict[int, Dict[str, str]]] = None,
@@ -494,6 +695,13 @@ class ShardSupervisor:
         self.ready_timeout_s = ready_timeout_s
         self._want_router = router
         self._want_restart = restart
+        #: failover mode: a dead shard's experiments are recovered from
+        #: its snapshot+WAL on disk and handed to the SURVIVORS instead
+        #: of respawning it (requires ``snapshot_dir``; see _failover_shard)
+        self._want_failover = failover and restart
+        if failover and snapshot_dir is None:
+            raise ValueError("failover mode needs a snapshot_dir to "
+                             "recover a dead shard's state from")
         #: extra env per shard index, applied to the FIRST incarnation
         #: only — the chaos test arms METAOPT_TPU_FAULTS on one shard here
         self._shard_env = dict(shard_env or {})
@@ -507,6 +715,11 @@ class ShardSupervisor:
         #: wall time from each spawn to its ready line — entry 0 is the
         #: cold start, later entries are restart+recovery times
         self.recovery_times: List[float] = []
+        #: wall time of each completed failover (death detected →
+        #: survivors own every recovered experiment) — the
+        #: coord_failover_time_s bench metric
+        self.failover_times: List[float] = []
+        self._failover_threads: List[threading.Thread] = []
         self._procs_lock = threading.Lock()
         self._stopping = threading.Event()
         self._watcher: Optional[threading.Thread] = None
@@ -527,11 +740,12 @@ class ShardSupervisor:
     def start(self) -> "ShardSupervisor":
         while len(self._shard_ports) < self.n_shards:
             self._shard_ports.append(_free_port(self.host))
-        self.shard_map = make_shard_map(
-            [(f"s{i}", self.host, self._shard_ports[i])
-             for i in range(self.n_shards)],
-            vnodes=self.vnodes,
-        )
+        with self._procs_lock:
+            self.shard_map = make_shard_map(
+                [(f"s{i}", self.host, self._shard_ports[i])
+                 for i in range(self.n_shards)],
+                vnodes=self.vnodes,
+            )
         # spawn all shards first, then wait: cold starts overlap. Any
         # failure past the first spawn (a shard that never comes up, a
         # router port already bound) must reap every child already
@@ -560,6 +774,10 @@ class ShardSupervisor:
         self._stopping.set()
         if self._watcher is not None:
             self._watcher.join(timeout=10)
+        with self._procs_lock:
+            fthreads = list(self._failover_threads)
+        for t in fthreads:
+            t.join(timeout=30)
         if self.router is not None:
             self.router.stop()
         with self._procs_lock:
@@ -682,12 +900,110 @@ class ShardSupervisor:
                 items = list(self._shards.items())
             for i, rec in items:
                 if rec.proc.poll() is not None and not self._stopping.is_set():
+                    with self._procs_lock:
+                        survivors = len(self._shards) - 1
+                    if self._want_failover and survivors >= 1:
+                        log.warning("shard %d died (rc=%s); failing its "
+                                    "experiments over to %d survivor(s)",
+                                    i, rec.proc.returncode, survivors)
+                        t = threading.Thread(
+                            target=self._failover_shard, args=(i,),
+                            name=f"coord-shard-failover-{i}", daemon=True)
+                        with self._procs_lock:
+                            # drop the dead incarnation from the live set
+                            # FIRST so the watcher never double-fires
+                            self._shards.pop(i, None)
+                            self._failover_threads.append(t)
+                        t.start()
+                        continue
                     log.warning("shard %d died (rc=%s); restarting with "
                                 "recovery", i, rec.proc.returncode)
                     # respawn is non-blocking (readiness lands via the
                     # drain thread), so one shard's replay never delays
                     # death detection for the others
                     self._spawn(i, disarm=True)
+
+    def _failover_shard(self, i: int) -> None:
+        """Recover dead shard ``i``'s experiments onto the survivors.
+
+        Runs in its own ``coord-shard-failover-{i}`` thread so death
+        detection (and failover of a SECOND shard) never waits on this
+        one's WAL replay. The dead shard's snapshot + WAL are read
+        straight off disk (:func:`~metaopt_tpu.coord.handoff.
+        recover_shard_state`) and each experiment is pushed to its new
+        owner through the same idempotent ``handoff_apply`` op a live
+        migration uses — one recovery path, not two.
+        """
+        from metaopt_tpu.coord.handoff import (
+            apply_recovered, call_admin, recover_shard_state)
+
+        t0 = time.monotonic()
+        dead_sid = f"s{i}"
+        try:
+            with self._procs_lock:
+                assert self.shard_map is not None
+                cur = self.shard_map
+            new_map = without_shard(cur, dead_sid)
+            assert self.snapshot_dir is not None
+            snap = os.path.join(self.snapshot_dir, f"shard-{i}.snap.json")
+            states = recover_shard_state(snap, snap + ".wal")
+            table = RoutingTable(new_map)
+            for exp, state in sorted(states.items()):
+                apply_recovered(exp, state, table.addrs[table.owner(exp)],
+                                new_map)
+            # every survivor must adopt the shrunken map (the applies
+            # taught only each experiment's new owner)
+            for addr in table.addrs.values():
+                try:
+                    call_admin(addr, "shard_map_update",
+                               {"shard_map": new_map}, window_s=5.0)
+                except Exception:
+                    log.warning("failover: map broadcast to %s failed",
+                                addr, exc_info=True)
+            with self._procs_lock:
+                if map_version(self.shard_map) < map_version(new_map):
+                    self.shard_map = new_map
+                self.failover_times.append(time.monotonic() - t0)
+            if self.router is not None:
+                self.router.update_map(new_map)
+            log.warning("failover of shard %d done: %d experiment(s) "
+                        "redistributed in %.2fs", i, len(states),
+                        time.monotonic() - t0)
+        except Exception:
+            # a failed failover must not kill the watcher's process —
+            # the experiments stay recoverable on disk for a retry/drill
+            log.exception("failover of shard %d failed", i)
+
+    # -- live rebalance ----------------------------------------------------
+    def handoff(self, experiment: str, dest_sid: str,
+                drain_timeout_s: float = 10.0,
+                window_s: float = 30.0) -> Optional[Dict[str, Any]]:
+        """Migrate ``experiment`` to ``dest_sid`` live; None if already
+        there. Runs the full prepare→ship→apply→commit protocol
+        (:func:`~metaopt_tpu.coord.handoff.migrate_experiment`) and
+        teaches the router + supervisor map the bumped version."""
+        from metaopt_tpu.coord.handoff import migrate_experiment
+
+        with self._procs_lock:
+            assert self.shard_map is not None, "supervisor not started"
+            cur = self.shard_map
+        table = RoutingTable(cur)
+        source_sid = table.owner(experiment)
+        if source_sid == dest_sid:
+            return None
+        new_map = with_override(cur, experiment, dest_sid)
+        others = [a for sid, a in table.addrs.items()
+                  if sid not in (source_sid, dest_sid)]
+        result = migrate_experiment(
+            experiment, table.addrs[source_sid], table.addrs[dest_sid],
+            dest_sid, new_map, other_addrs=others,
+            drain_timeout_s=drain_timeout_s, window_s=window_s)
+        with self._procs_lock:
+            if map_version(self.shard_map) < map_version(new_map):
+                self.shard_map = new_map
+        if self.router is not None:
+            self.router.update_map(new_map)
+        return result
 
 
 # ---------------------------------------------------------------------------

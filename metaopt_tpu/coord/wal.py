@@ -102,6 +102,30 @@ def read_records(path: str, truncate_torn: bool = True
     return records, torn
 
 
+def record_experiment(rec: Dict[str, Any]) -> Optional[str]:
+    """Which experiment a WAL record belongs to, or ``None`` for global
+    records (``shard_map`` adoption markers, unknown kinds).
+
+    Hand-off ships exactly the records the destination needs to redo one
+    experiment, so attribution must agree with how ``_apply_wal_record``
+    reads each kind back: trial records via the embedded doc, experiment
+    lifecycle ops via their name argument, reply records via the ``exp``
+    tag stamped by ``_journal_reply``.
+    """
+    op = rec.get("op")
+    if op == "put_trial":
+        return (rec.get("trial") or {}).get("experiment")
+    if op == "create_experiment":
+        return (rec.get("config") or {}).get("name")
+    if op in ("update_experiment", "delete_experiment"):
+        return rec.get("name")
+    if op == "set_signal":
+        return rec.get("experiment")
+    if op == "reply":
+        return rec.get("exp")
+    return None
+
+
 def fsync_dir(path: str) -> None:
     """fsync the parent directory so a rename/creat is itself durable."""
     d = os.path.dirname(os.path.abspath(path)) or "."
@@ -138,6 +162,7 @@ class WriteAheadLog:
         self._durable = 0    # last seq known fsynced
         self._syncing = False
         self._failed = False  # fsync/write failed: journaling degraded
+        self._fence = 0      # open compaction fences (hand-off tail ships)
         self._f: Optional[Any] = None
         self.batches = 0     # fsync batches written (amortization telemetry)
         self.records = 0
@@ -286,6 +311,68 @@ class WriteAheadLog:
             self.batches += 1
             self.records += len(batch)
 
+    # -- hand-off ---------------------------------------------------------
+    def compaction_fence(self) -> "_CompactionFence":
+        """Context manager that blocks :meth:`compact` for its duration.
+
+        A hand-off extracts an experiment's tail with :meth:`extract_tail`
+        and then keeps referring to those seqs until the ownership commit;
+        a compaction sneaking in between would rewrite the file out from
+        under the ship. ``compact()`` waits while any fence is open;
+        appends and syncs are unaffected.
+        """
+        return _CompactionFence(self)
+
+    def extract_tail(self, experiment: str) -> List[Dict[str, Any]]:
+        """All on-disk + buffered records attributed to ``experiment``.
+
+        Takes the group-commit leader role so the pending buffer is
+        flushed first and no concurrent batch interleaves with the read —
+        the returned tail is therefore complete up to every acknowledged
+        write at the moment of the call. Call under a
+        :meth:`compaction_fence` when the result must stay valid until an
+        ownership commit.
+        """
+        if self._f is None:
+            return []
+        while True:
+            with self._cv:
+                if self._syncing:
+                    self._cv.wait(timeout=1.0)
+                    continue
+                self._syncing = True
+            break
+        upto = 0
+        try:
+            with self._buf_lock:
+                batch, self._pending = self._pending, []
+                upto = self._appended
+            try:
+                self._write_batch(batch)
+            except OSError:
+                log.exception("WAL extract_tail flush failed")
+                with self._cv:
+                    self._failed = True
+                return []
+            records, _ = read_records(self.path, truncate_torn=False)
+            return [r for r in records
+                    if record_experiment(r) == experiment]
+        finally:
+            with self._cv:
+                if not self._failed:
+                    self._durable = max(self._durable, upto)
+                self._syncing = False
+                self._cv.notify_all()
+
+    def _fence_enter(self) -> None:
+        with self._cv:
+            self._fence += 1
+
+    def _fence_exit(self) -> None:
+        with self._cv:
+            self._fence = max(0, self._fence - 1)
+            self._cv.notify_all()
+
     # -- maintenance ------------------------------------------------------
     def compact(self, upto_seq: int) -> None:
         """Drop every record with ``seq <= upto_seq`` (they are reflected
@@ -299,7 +386,9 @@ class WriteAheadLog:
             return
         while True:
             with self._cv:
-                if self._syncing:
+                # a hand-off fence holds compaction off entirely: the
+                # shipped tail must stay on disk until ownership commits
+                if self._syncing or self._fence > 0:
                     self._cv.wait(timeout=1.0)
                     continue
                 self._syncing = True
@@ -340,3 +429,17 @@ class WriteAheadLog:
                     self._durable = max(self._durable, upto)
                 self._syncing = False
                 self._cv.notify_all()
+
+
+class _CompactionFence:
+    """``with wal.compaction_fence():`` — holds :meth:`compact` off."""
+
+    def __init__(self, wal: WriteAheadLog) -> None:
+        self._wal = wal
+
+    def __enter__(self) -> "_CompactionFence":
+        self._wal._fence_enter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._wal._fence_exit()

@@ -40,6 +40,26 @@ so arm them only in a subprocess-hosted server):
 - ``partial_snapshot``: die mid-snapshot with a truncated ``.tmp`` on
   disk, before the atomic rename — recovery must ignore the torn tmp and
   come back from the previous snapshot + un-compacted WAL.
+
+Hand-off kinds (consumed in ``coord/server.py``; armed at every barrier
+of the live-migration protocol by the chaos sweep — the ``@skip``
+selector walks the barriers in order):
+
+- ``crash_handoff_source``: die on the SOURCE shard — skip 0 fires
+  after the experiment is fenced but before its state is captured
+  (pre-snapshot), skip 1 fires after capture, before the reply ships
+  (post-snapshot). Either way nothing was shipped; the source's own
+  WAL + fence journaling must bring it back still owning the
+  experiment.
+- ``crash_handoff_dest``: die on the DESTINATION shard — skip 0 fires
+  before any shipped state is applied (pre-commit), skip 1 fires after
+  the shipped state is journaled + fsynced but before the apply reply
+  (post-commit). The orchestrator's retry against the respawned dest
+  must be idempotent.
+- ``torn_handoff_ship``: die on the destination mid-apply with only a
+  prefix of the shipped trial docs journaled — recovery replays the
+  partial prefix harmlessly and the orchestrator's retried apply
+  completes the move.
 """
 
 from __future__ import annotations

@@ -1,0 +1,246 @@
+"""Kill -9 chaos for LIVE HAND-OFF and failover (ISSUE 8).
+
+The migration protocol's crash matrix, exercised for real: a 2-shard
+ShardSupervisor migrates a live experiment while worker threads keep
+completing trials on it, and an armed chaos fault SIGKILLs the source or
+destination shard at each protocol barrier (``@skip`` selects the
+barrier — see the crash matrix in :mod:`metaopt_tpu.coord.handoff`).
+The watcher respawns the victim with faults disarmed, the orchestrator's
+retry window rides through the crash, and the acceptance invariants are
+the tentpole's contract:
+
+- **zero acked-write loss**: every completion acknowledged before or
+  during the migration is present after it commits;
+- **no duplicate registrations**: blind upsert retries through the kill
+  never mint a second copy of a trial;
+- **liveness**: the fence lifts, the budget fully drains, and the moved
+  experiment ends up owned by the destination.
+
+The failover drill runs the same machinery in ``failover=True`` mode: a
+dead shard is never respawned — its experiments are recovered from its
+snapshot+WAL on disk and handed to the survivors while they keep
+serving their own traffic.
+
+Marked ``slow``: tier-1 CI (-m 'not slow') skips these.
+"""
+
+import threading
+import time
+
+import pytest
+
+from metaopt_tpu.coord import CoordLedgerClient, ShardSupervisor
+from metaopt_tpu.coord.shards import RoutingTable, make_shard_map, ring_of
+from metaopt_tpu.ledger import Experiment
+from metaopt_tpu.space import build_space
+
+pytestmark = pytest.mark.slow
+
+
+def _exp_owned_by(sid: str, prefix: str = "chaos-handoff") -> str:
+    """An experiment name the 2-shard ring assigns to ``sid``.
+
+    The ring hashes shard IDs (not ports), so ownership is computable
+    before the supervisor exists — which is what lets the test arm the
+    chaos fault on the right shard index at spawn time.
+    """
+    ring = ring_of(make_shard_map([("s0", "127.0.0.1", 1),
+                                   ("s1", "127.0.0.1", 2)]))
+    i = 0
+    while True:
+        nm = f"{prefix}-{i}"
+        if ring.owner(nm) == sid:
+            return nm
+        i += 1
+
+
+def _run_workers(host, port, nm, budget, workers, acked, acked_lock,
+                 errors, deadline_s=180.0):
+    def worker(w):
+        # own client per thread: wedging on a dead shard must not hold
+        # up the others; Migrating/WrongShardError retry inside _call
+        c = CoordLedgerClient(host=host, port=port,
+                              reconnect_window_s=30.0)
+        try:
+            complete = None
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                out = c.worker_cycle(nm, w, pool_size=workers,
+                                     complete=complete)
+                if complete is not None:
+                    # the cycle returned → the piggybacked complete leg
+                    # was fsynced and acknowledged
+                    with acked_lock:
+                        acked[nm] += 1
+                complete = None
+                t = out["trial"]
+                if t is None:
+                    if out["counts"]["completed"] >= budget:
+                        return
+                    time.sleep(0.002)
+                    continue
+                t.attach_results([{
+                    "name": "objective", "type": "objective",
+                    "value": t.params["x"] ** 2,
+                }])
+                t.transition("completed")
+                complete = {"trial": t.to_dict(),
+                            "expected_status": "reserved",
+                            "expected_worker": w}
+            raise AssertionError(f"{nm}: budget not drained")
+        except BaseException as e:  # surfaced after join
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(f"{nm}-w{j}",),
+                                name=f"chaos-handoff-worker-{j}")
+               for j in range(workers)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+@pytest.mark.parametrize("kind,skip,victim_idx", [
+    # source barriers: pre-snapshot (fence not yet durable) and
+    # post-capture (fence durable, reply lost)
+    ("crash_handoff_source", 0, 0),
+    ("crash_handoff_source", 1, 0),
+    # destination barriers: pre-commit (nothing applied) and post-commit
+    # (state+map durable, ack lost)
+    ("crash_handoff_dest", 0, 1),
+    ("crash_handoff_dest", 1, 1),
+    # mid-ship: a prefix of the docs journaled, then SIGKILL
+    ("torn_handoff_ship", 0, 1),
+])
+def test_kill9_at_barrier_zero_acked_loss(tmp_path, kind, skip, victim_idx):
+    budget = 40
+    workers = 4
+    nm = _exp_owned_by("s0")  # source is always shard 0, dest shard 1
+    env = {victim_idx: {"METAOPT_TPU_FAULTS": f"{kind}:1@{skip}"}}
+    with ShardSupervisor(2, snapshot_dir=str(tmp_path),
+                         snapshot_interval_s=0.5, restart=True,
+                         shard_env=env) as sup:
+        host, port = sup.address
+        client = CoordLedgerClient(host=host, port=port,
+                                   reconnect_window_s=30.0)
+        client.ping()
+        Experiment(
+            nm, client, space=build_space({"x": "uniform(-1, 1)"}),
+            max_trials=budget, pool_size=workers,
+            algorithm={"random": {"seed": 13}},
+        ).configure()
+
+        acked_lock = threading.Lock()
+        acked = {nm: 0}
+        errors = []
+        threads = _run_workers(host, port, nm, budget, workers,
+                               acked, acked_lock, errors)
+
+        # take acked load first so the kill has something to lose
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with acked_lock:
+                if acked[nm] >= 5:
+                    break
+            time.sleep(0.01)
+        with acked_lock:
+            acked_before = acked[nm]
+        assert acked_before >= 5, "no acked load before the migration"
+
+        # the migration: the armed fault SIGKILLs the victim at its
+        # barrier, the watcher respawns it disarmed, and the retry
+        # window inside migrate_experiment rides through the crash
+        sup.handoff(nm, "s1", drain_timeout_s=15.0, window_s=60.0)
+
+        assert sup.crashes() == 1, "the armed fault never fired"
+        assert RoutingTable(sup.shard_map).owner(nm) == "s1"
+
+        # zero acked-write loss across the crash + migration
+        assert client.count(nm, "completed") >= acked_before
+
+        for t in threads:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in threads), "workers wedged"
+        if errors:
+            raise errors[0]
+
+        # liveness + no duplicate registrations after blind retries
+        assert client.count(nm, "completed") == budget
+        docs = client.fetch(nm)
+        ids = [t.id for t in docs]
+        assert len(ids) == len(set(ids)), "duplicate trial registrations"
+        assert len(ids) == budget
+        with acked_lock:
+            assert client.count(nm, "completed") >= acked[nm]
+
+
+def test_failover_drill_survivors_absorb_dead_shard(tmp_path):
+    """failover=True: kill a shard mid-load; its experiment is recovered
+    from disk and adopted by the survivor while BOTH experiments keep
+    draining; the dead shard is never respawned."""
+    budget = 40
+    workers = 2
+    victim_exp = _exp_owned_by("s0", prefix="chaos-failover")
+    survivor_exp = _exp_owned_by("s1", prefix="chaos-failover")
+    with ShardSupervisor(2, snapshot_dir=str(tmp_path),
+                         snapshot_interval_s=0.5, restart=True,
+                         failover=True) as sup:
+        host, port = sup.address
+        client = CoordLedgerClient(host=host, port=port,
+                                   reconnect_window_s=30.0)
+        client.ping()
+        for nm in (victim_exp, survivor_exp):
+            Experiment(
+                nm, client, space=build_space({"x": "uniform(-1, 1)"}),
+                max_trials=budget, pool_size=workers,
+                algorithm={"random": {"seed": 13}},
+            ).configure()
+
+        acked_lock = threading.Lock()
+        acked = {victim_exp: 0, survivor_exp: 0}
+        errors = []
+        threads = []
+        for nm in (victim_exp, survivor_exp):
+            threads += _run_workers(host, port, nm, budget, workers,
+                                    acked, acked_lock, errors)
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with acked_lock:
+                if min(acked.values()) >= 5:
+                    break
+            time.sleep(0.01)
+        with acked_lock:
+            acked_before = dict(acked)
+        assert min(acked_before.values()) >= 5
+
+        sup.kill_shard(0)
+
+        # the failover thread recovers s0's experiment from its
+        # snapshot+WAL and hands it to s1; wait for the redistribution
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not sup.failover_times:
+            time.sleep(0.02)
+        assert sup.failover_times, "failover never completed"
+
+        # the ring shrank: s0 is gone, the survivor owns everything
+        sids = {s["id"] for s in sup.shard_map["shards"]}
+        assert sids == {"s1"}
+        assert RoutingTable(sup.shard_map).owner(victim_exp) == "s1"
+
+        for t in threads:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in threads), "workers wedged"
+        if errors:
+            raise errors[0]
+
+        # dead shard never respawned; nothing acked was lost; both
+        # budgets drained through the survivor
+        assert sup.crashes() == 1
+        assert len(sup.failover_times) == 1
+        for nm in (victim_exp, survivor_exp):
+            final = client.count(nm, "completed")
+            assert final >= acked_before[nm]
+            assert final == budget
+            docs = client.fetch(nm)
+            ids = [t.id for t in docs]
+            assert len(ids) == len(set(ids)) == budget

@@ -1,0 +1,459 @@
+"""Live shard hand-off: migration protocol, fences, map monotonicity.
+
+The invariant under test (ARCHITECTURE.md "Hand-off & failover"): a live
+experiment moves between running shards with ZERO acked-write loss —
+every trial acknowledged before the move exists after it, an in-flight
+exactly-once retry that straddles the move is answered from the SHIPPED
+reply cache, and routing (client, router, server) converges on the
+version-bumped shard map without ever rolling back. Crash coverage at
+each protocol barrier lives in tests/functional/test_coord_handoff_chaos.py.
+"""
+
+import json
+import socket
+import threading
+import time
+import uuid
+
+import pytest
+
+from metaopt_tpu.coord import CoordLedgerClient, ShardSupervisor
+from metaopt_tpu.coord.handoff import recover_shard_state
+from metaopt_tpu.coord.protocol import recv_msg, send_msg
+from metaopt_tpu.coord.shards import (
+    RoutingTable,
+    map_version,
+    make_shard_map,
+    ring_of,
+    with_override,
+    without_shard,
+)
+from metaopt_tpu.coord.wal import WriteAheadLog, record_experiment
+from metaopt_tpu.ledger import Experiment
+from metaopt_tpu.space import build_space
+
+
+def _client(host, port, window=30.0):
+    return CoordLedgerClient(host=host, port=port,
+                             reconnect_window_s=window)
+
+
+def _configure(client, name, budget=6):
+    Experiment(
+        name, client, space=build_space({"x": "uniform(-1, 1)"}),
+        max_trials=budget, pool_size=3,
+        algorithm={"random": {"seed": 5}},
+    ).configure()
+
+
+def _drain(client, name, budget, worker="w0"):
+    complete = None
+    for _ in range(budget * 6):
+        out = client.worker_cycle(name, worker, pool_size=3,
+                                  complete=complete)
+        complete = None
+        t = out["trial"]
+        if t is None:
+            if out["counts"]["completed"] >= budget:
+                return
+            continue
+        t.attach_results([{"name": "objective", "type": "objective",
+                           "value": t.params["x"] ** 2}])
+        t.transition("completed")
+        complete = {"trial": t.to_dict(), "expected_status": "reserved",
+                    "expected_worker": worker}
+    raise AssertionError(f"{name}: budget {budget} not drained")
+
+
+def _raw_call(addr, msg):
+    with socket.create_connection(addr, timeout=10) as s:
+        send_msg(s, msg)
+        return recv_msg(s)
+
+
+def _split_names(shard_map, prefix):
+    """(name owned by shard 0's id, its sid, the other sid)."""
+    ring = ring_of(shard_map)
+    sids = [s["id"] for s in shard_map["shards"]]
+    i = 0
+    while True:
+        nm = f"{prefix}-{i}"
+        if ring.owner(nm) == sids[0]:
+            return nm, sids[0], sids[1]
+        i += 1
+
+
+class TestMapHelpers:
+    def test_with_override_bumps_version_and_pins(self):
+        m = make_shard_map([("s0", "h", 1), ("s1", "h", 2)])
+        nm, src, dest = _split_names(m, "ov")
+        m2 = with_override(m, nm, dest)
+        assert map_version(m2) == map_version(m) + 1
+        assert RoutingTable(m2).owner(nm) == dest
+        # the input map is untouched (deep copy)
+        assert "overrides" not in m or not m.get("overrides")
+        assert RoutingTable(m).owner(nm) == src
+
+    def test_with_override_unpins_natural_owner(self):
+        m = make_shard_map([("s0", "h", 1), ("s1", "h", 2)])
+        nm, src, dest = _split_names(m, "nat")
+        m2 = with_override(m, nm, dest)
+        # moving it BACK to the ring owner drops the pin instead of
+        # keeping a redundant override forever
+        m3 = with_override(m2, nm, src)
+        assert m3["overrides"] == {}
+        assert RoutingTable(m3).owner(nm) == src
+
+    def test_with_override_rejects_unknown_dest(self):
+        m = make_shard_map([("s0", "h", 1)])
+        with pytest.raises(ValueError):
+            with_override(m, "e", "s9")
+
+    def test_without_shard_drops_dead_overrides_only(self):
+        m = make_shard_map([("s0", "h", 1), ("s1", "h", 2),
+                            ("s2", "h", 3)])
+        ring = ring_of(m)
+        # names whose natural owner is NOT the pin target, so the
+        # overrides survive with_override's un-pin rule
+        pin_dead = next(f"pd-{i}" for i in range(999)
+                        if ring.owner(f"pd-{i}") != "s0")
+        pin_live = next(f"pl-{i}" for i in range(999)
+                        if ring.owner(f"pl-{i}") not in ("s0", "s1"))
+        m = with_override(m, pin_dead, "s0")
+        m = with_override(m, pin_live, "s1")
+        m2 = without_shard(m, "s0")
+        assert [s["id"] for s in m2["shards"]] == ["s1", "s2"]
+        assert pin_dead not in m2["overrides"]
+        assert m2["overrides"].get(pin_live) == "s1"
+        assert map_version(m2) == map_version(m) + 1
+        with pytest.raises(ValueError):
+            without_shard(without_shard(m2, "s1"), "s2")
+
+    def test_routing_table_owner_matches_ring_without_overrides(self):
+        m = make_shard_map([("s0", "h", 1), ("s1", "h", 2)])
+        ring, table = ring_of(m), RoutingTable(m)
+        for i in range(100):
+            assert table.owner(f"e{i}") == ring.owner(f"e{i}")
+
+
+class TestLiveMigration:
+    def test_migration_preserves_acked_trials(self, tmp_path):
+        with ShardSupervisor(2, snapshot_dir=str(tmp_path),
+                             restart=False) as sup:
+            host, port = sup.address
+            c = _client(host, port)
+            c.ping()
+            table = RoutingTable(sup.shard_map)
+            nm = "mig-a"
+            src = table.owner(nm)
+            dest = [s["id"] for s in sup.shard_map["shards"]
+                    if s["id"] != src][0]
+            _configure(c, nm)
+            _drain(c, nm, 3)
+            ids_before = {t.id for t in c.fetch(nm)}
+            completed_before = c.count(nm, "completed")
+            assert completed_before >= 3
+            res = sup.handoff(nm, dest)
+            assert res is not None and res["trials"] == len(ids_before)
+            # same supervisor call again is a no-op (already there)
+            assert sup.handoff(nm, dest) is None
+            assert RoutingTable(sup.shard_map).owner(nm) == dest
+            # the client follows the bumped map and sees every acked
+            # trial exactly once — no loss, no duplicates
+            after = [t.id for t in c.fetch(nm)]
+            assert sorted(after) == sorted(ids_before)
+            assert c.count(nm, "completed") == completed_before
+            # and keeps completing trials against the new owner
+            _drain(c, nm, 6)
+            assert c.count(nm, "completed") == 6
+
+    def test_exactly_once_retry_spans_migration(self, tmp_path):
+        # a fused worker_cycle answered by the SOURCE whose client then
+        # retries (same request id) against the DESTINATION after the
+        # move must get the cached reply back, not a re-execution —
+        # the reply cache ships with the experiment
+        with ShardSupervisor(2, snapshot_dir=str(tmp_path),
+                             restart=False) as sup:
+            host, port = sup.address
+            c = _client(host, port)
+            c.ping()
+            table = RoutingTable(sup.shard_map)
+            nm = "mig-b"
+            src = table.owner(nm)
+            dest = [s["id"] for s in sup.shard_map["shards"]
+                    if s["id"] != src][0]
+            _configure(c, nm)
+            addrs = table.addrs
+            req = uuid.uuid4().hex
+            msg = {"op": "worker_cycle", "req": req,
+                   "args": {"experiment": nm, "worker": "w-retry",
+                            "pool_size": 3, "produce": True,
+                            "complete": None}}
+            first = _raw_call(addrs[src], msg)
+            assert first["ok"] and first["result"]["trial"] is not None
+            sup.handoff(nm, dest)
+            # the "lost reply" retry lands on the new owner
+            second = _raw_call(addrs[dest], msg)
+            assert second["ok"], second
+            assert second["result"] == first["result"]
+            # and it did NOT re-reserve: the trial reserved by the first
+            # call is still the only reserved one
+            assert c.count(nm, "reserved") == 1
+
+    def test_source_answers_wrong_shard_after_commit(self, tmp_path):
+        with ShardSupervisor(2, snapshot_dir=str(tmp_path),
+                             restart=False) as sup:
+            host, port = sup.address
+            c = _client(host, port)
+            c.ping()
+            table = RoutingTable(sup.shard_map)
+            nm = "mig-c"
+            src = table.owner(nm)
+            dest = [s["id"] for s in sup.shard_map["shards"]
+                    if s["id"] != src][0]
+            _configure(c, nm)
+            sup.handoff(nm, dest)
+            r = _raw_call(table.addrs[src],
+                          {"op": "load_experiment", "req": uuid.uuid4().hex,
+                           "args": {"name": nm}})
+            assert not r["ok"] and r["error"] == "WrongShardError"
+
+    def test_migration_under_concurrent_writes(self, tmp_path):
+        # workers hammer the experiment THROUGH the migration; the fence
+        # answers Migrating (retryable) during the move and every
+        # acknowledged completion must exist afterwards
+        with ShardSupervisor(2, snapshot_dir=str(tmp_path),
+                             restart=False) as sup:
+            host, port = sup.address
+            table = RoutingTable(sup.shard_map)
+            nm = "mig-d"
+            src = table.owner(nm)
+            dest = [s["id"] for s in sup.shard_map["shards"]
+                    if s["id"] != src][0]
+            boot = _client(host, port)
+            _configure(boot, nm, budget=40)
+            acked = []
+            stop = threading.Event()
+            fails = []
+
+            def work(wid):
+                cl = _client(host, port)
+                complete = None
+                try:
+                    while not stop.is_set():
+                        out = cl.worker_cycle(nm, wid, pool_size=4,
+                                              complete=complete)
+                        if complete is not None \
+                                and out.get("completed_ok"):
+                            acked.append(complete["trial"]["id"])
+                        complete = None
+                        t = out["trial"]
+                        if t is None:
+                            time.sleep(0.01)
+                            continue
+                        t.attach_results([
+                            {"name": "objective", "type": "objective",
+                             "value": t.params["x"] ** 2}])
+                        t.transition("completed")
+                        complete = {"trial": t.to_dict(),
+                                    "expected_status": "reserved",
+                                    "expected_worker": wid}
+                except Exception as e:  # pragma: no cover - debug aid
+                    fails.append(e)
+
+            threads = [threading.Thread(target=work, args=(f"w{i}",),
+                                        daemon=True) for i in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # let writes get going
+            sup.handoff(nm, dest)
+            time.sleep(0.3)  # and keep going on the new owner
+            stop.set()
+            for t in threads:
+                t.join(timeout=20)
+            assert not fails, fails
+            final = {t.id for t in boot.fetch(nm)}
+            lost = set(acked) - final
+            assert not lost, f"acked completions lost in the move: {lost}"
+            assert RoutingTable(sup.shard_map).owner(nm) == dest
+
+
+class TestClientMapMonotonicity:
+    def test_stale_lower_version_map_never_rolls_back(self):
+        # satellite: a delayed ping reply carrying the PRE-migration map
+        # must not re-route acked writes to the shard that dropped the
+        # experiment
+        old = make_shard_map([("s0", "h", 1), ("s1", "h", 2)])
+        nm, src, dest = _split_names(old, "mono")
+        new = with_override(old, nm, dest)
+        c = CoordLedgerClient(host="127.0.0.1", port=9)
+        c._caps = ("shard_map",)
+        c._absorb_ping(c._seed, {"caps": ["shard_map"], "shard_map": new})
+        assert c._ring.owner(nm) == dest
+        assert c._map_version == map_version(new)
+        # stale reply arrives late: ignored
+        c._absorb_ping(c._seed, {"caps": ["shard_map"], "shard_map": old})
+        assert c._ring.owner(nm) == dest, "routing rolled back"
+        assert c._map_version == map_version(new)
+        # an equal-or-newer map is still adopted
+        newer = with_override(new, nm, src)
+        c._absorb_ping(c._seed, {"caps": ["shard_map"],
+                                 "shard_map": newer})
+        assert c._map_version == map_version(newer)
+
+    def test_cap_withdrawal_still_degrades(self):
+        # rolling back to an UNSHARDED server is a legitimate downgrade —
+        # monotonicity applies to map versions, not to losing the cap
+        m = make_shard_map([("s0", "h", 1)])
+        c = CoordLedgerClient(host="127.0.0.1", port=9)
+        c._caps = ("shard_map",)
+        c._absorb_ping(c._seed, {"caps": ["shard_map"], "shard_map": m})
+        assert c._ring is not None
+        c._absorb_ping(c._seed, {"caps": []})
+        assert c._ring is None and c._map_version == -1
+
+
+class TestWalHandoffSupport:
+    def test_record_experiment_attribution(self):
+        assert record_experiment(
+            {"op": "put_trial", "trial": {"experiment": "e1"}}) == "e1"
+        assert record_experiment(
+            {"op": "create_experiment",
+             "config": {"name": "e2"}}) == "e2"
+        assert record_experiment(
+            {"op": "update_experiment", "name": "e3"}) == "e3"
+        assert record_experiment(
+            {"op": "set_signal", "experiment": "e4"}) == "e4"
+        assert record_experiment(
+            {"op": "reply", "req": "r", "exp": "e5"}) == "e5"
+        # global records never ship in a per-experiment tail
+        assert record_experiment({"op": "shard_map", "map": {}}) is None
+        assert record_experiment(
+            {"op": "handoff_fence", "experiment": "e6"}) is None
+
+    def test_extract_tail_filters_by_experiment(self, tmp_path):
+        path = str(tmp_path / "t.wal")
+        wal = WriteAheadLog(path, fsync=False).open()
+        try:
+            wal.append({"op": "put_trial",
+                        "trial": {"id": "a", "experiment": "keep"}})
+            wal.append({"op": "put_trial",
+                        "trial": {"id": "b", "experiment": "other"}})
+            wal.append({"op": "set_signal", "experiment": "keep",
+                        "trial_id": "a", "signal": "stop"})
+            tail = wal.extract_tail("keep")
+        finally:
+            wal.close()
+        assert [r["op"] for r in tail] == ["put_trial", "set_signal"]
+        assert all(record_experiment(r) == "keep" for r in tail)
+
+    def test_compaction_fenced_during_tail_extraction(self, tmp_path):
+        # satellite: compact() racing extract_tail could rewrite the log
+        # under the reader — the fence must hold it off until released
+        path = str(tmp_path / "f.wal")
+        wal = WriteAheadLog(path, fsync=False).open()
+        try:
+            for i in range(5):
+                wal.append({"op": "put_trial",
+                            "trial": {"id": f"t{i}", "experiment": "e"}})
+            wal.sync(wal.appended_seq)
+            started = threading.Event()
+            done = threading.Event()
+
+            def compact_racer():
+                started.set()
+                wal.compact(2)
+                done.set()
+
+            with wal.compaction_fence():
+                t = threading.Thread(target=compact_racer, daemon=True)
+                t.start()
+                started.wait(5)
+                # compaction must be parked while the fence is held
+                assert not done.wait(0.3), \
+                    "compact() ran inside a compaction fence"
+                tail = wal.extract_tail("e")
+                assert len(tail) == 5
+            assert done.wait(5), "compact() never resumed after the fence"
+            t.join(timeout=5)
+            # the compaction kept only seqs > 2 — intact and readable
+            assert len(wal.extract_tail("e")) == 3
+        finally:
+            wal.close()
+
+
+class TestOfflineRecovery:
+    def test_recover_from_wal_only(self, tmp_path):
+        wal_path = str(tmp_path / "dead.wal")
+        wal = WriteAheadLog(wal_path, fsync=False).open()
+        try:
+            wal.append({"op": "create_experiment",
+                        "config": {"name": "exp-a", "max_trials": 5}})
+            wal.append({"op": "put_trial",
+                        "trial": {"id": "t1", "experiment": "exp-a",
+                                  "status": "completed"}})
+            wal.append({"op": "put_trial",
+                        "trial": {"id": "t1", "experiment": "exp-a",
+                                  "status": "completed",
+                                  "objective": 1.0}})  # upsert wins
+            wal.append({"op": "set_signal", "experiment": "exp-a",
+                        "trial_id": "t1", "signal": "stop"})
+            wal.append({"op": "reply", "req": "r1", "exp": "exp-a",
+                        "reply": {"ok": True, "result": 1}})
+            wal.append({"op": "create_experiment",
+                        "config": {"name": "exp-b"}})
+            wal.append({"op": "delete_experiment", "name": "exp-b"})
+            wal.sync(wal.appended_seq)
+        finally:
+            wal.close()
+        state = recover_shard_state(None, wal_path)
+        assert set(state) == {"exp-a"}
+        s = state["exp-a"]
+        assert [t["id"] for t in s["trials"]] == ["t1"]
+        assert s["trials"][0]["objective"] == 1.0
+        assert s["signals"] == [{"trial_id": "t1", "signal": "stop"}]
+        assert s["replies"] == [
+            {"req": "r1", "reply": {"ok": True, "result": 1}}]
+
+    def test_recover_missing_files_is_empty(self, tmp_path):
+        assert recover_shard_state(str(tmp_path / "no.snap"),
+                                   str(tmp_path / "no.wal")) == {}
+
+
+class TestFailover:
+    def test_failover_redistributes_dead_shard(self, tmp_path):
+        with ShardSupervisor(2, snapshot_dir=str(tmp_path),
+                             failover=True) as sup:
+            host, port = sup.address
+            c = _client(host, port)
+            c.ping()
+            table = RoutingTable(sup.shard_map)
+            # one experiment on each shard
+            names = {}
+            i = 0
+            while len(names) < 2:
+                nm = f"fo-{i}"
+                names.setdefault(table.owner(nm), nm)
+                i += 1
+            completed = {}
+            for nm in names.values():
+                _configure(c, nm)
+                _drain(c, nm, 3)
+                completed[nm] = c.count(nm, "completed")
+                assert completed[nm] >= 3
+            sup.kill_shard(0)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not sup.failover_times:
+                time.sleep(0.05)
+            assert sup.failover_times, "failover never completed"
+            # the dead shard is gone from the map; every experiment —
+            # including the dead shard's — still answers with all trials
+            assert all(s["id"] != "s0" for s in sup.shard_map["shards"])
+            for nm in names.values():
+                assert c.count(nm, "completed") == completed[nm], nm
+            # no respawn happened: failover replaces restart
+            assert sup.crashes() == 1
+
+    def test_failover_requires_snapshot_dir(self):
+        with pytest.raises(ValueError):
+            ShardSupervisor(2, failover=True)
