@@ -912,6 +912,30 @@ def main() -> None:
     except Exception as err:  # the TPE headline must survive a coord break
         coord_stats["coord_bench_error"] = f"{type(err).__name__}: {err}"
 
+    # batched trial evaluation: a pool of k trials as ONE jitted vmap
+    # program vs k per-trial launches of the same math through
+    # InProcessExecutor (benchmarks/batch_eval.py). The speedup pairs
+    # both sides from THIS run (same-run ratio doctrine), and the
+    # launch-count telemetry under it confirms the pooled side really is
+    # one device program per pool. Dispatch-bound, so it is measured live
+    # on every run like the coord stats
+    batch_stats = {}
+    try:
+        from benchmarks.batch_eval import run_batch_eval
+
+        for bpool in (8, 64):
+            brow = run_batch_eval(bpool, reps=5)
+            batch_stats[f"batch_eval_trials_per_s_pool{bpool}"] = (
+                brow["batched_trials_per_s"])
+            if bpool == 64:
+                batch_stats["batch_eval_serial_trials_per_s"] = (
+                    brow["serial_trials_per_s"])
+                batch_stats["batch_eval_speedup"] = brow["speedup"]
+                batch_stats["batch_eval_launches_per_pool"] = (
+                    brow["launches_per_pool"])
+    except Exception as err:  # and survive a batch-eval break too
+        batch_stats["batch_eval_bench_error"] = f"{type(err).__name__}: {err}"
+
     # the xent A/B verdict: blocked-loss step-time win per seq (>1 = the
     # blocked online-softmax xent is faster than materializing (B, T, V)).
     # The default stage measures product routing (materializing at bench
@@ -951,6 +975,7 @@ def main() -> None:
             "mosaic_compile_probe": mosaic,
             **model_stats,
             **coord_stats,
+            **batch_stats,
         },
     }
     # Full record goes to a file; stdout gets ONE compact line. The driver
@@ -1035,7 +1060,10 @@ def main() -> None:
                 "gp_suggest_ms_per_point_1k_obs",
                 "gp_full_refit_ms_per_point_1k_obs",
                 "gp_incremental_speedup_vs_full_refit",
-                "gp_prefetch_hit_rate"):
+                "gp_prefetch_hit_rate",
+                "batch_eval_trials_per_s_pool8",
+                "batch_eval_trials_per_s_pool64",
+                "batch_eval_speedup", "batch_eval_launches_per_pool"):
         if key in result["extra"]:
             compact[key] = result["extra"][key]
     print(json.dumps(compact))

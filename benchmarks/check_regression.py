@@ -66,6 +66,15 @@ GP_SPEEDUP_METRIC = "gp_incremental_speedup_vs_full_refit"
 GP_SPEEDUP_FLOOR = 3.0
 #: speculative suggest-ahead effectiveness (higher is better)
 HIT_RATE_METRICS = ("gp_prefetch_hit_rate", "tpe_prefetch_hit_rate")
+#: batched trial evaluation: pooled-vmap throughput at pool 8/64 (higher
+#: is better, inverse gate like COORD_METRIC) and the same-run
+#: pooled-vs-per-trial speedup (higher is better; CPU artifacts
+#: additionally enforce the absolute acceptance floor, like the GP
+#: ratio). Informational until a committed baseline carries them.
+BATCH_TPS_METRICS = ("batch_eval_trials_per_s_pool8",
+                     "batch_eval_trials_per_s_pool64")
+BATCH_SPEEDUP_METRIC = "batch_eval_speedup"
+BATCH_SPEEDUP_FLOOR = 3.0
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -291,6 +300,44 @@ def main() -> int:
     else:
         print(f"OK {GP_SPEEDUP_METRIC}: {float(speedup):.2f}x "
               f"(floor {GP_SPEEDUP_FLOOR:.0f}x on cpu)")
+
+    # batched-eval throughput gates inversely (higher is better) against
+    # the last committed baseline carrying each key — informational until
+    # one does
+    for bkey in BATCH_TPS_METRICS:
+        bval = extra.get(bkey)
+        b_bases = [b for b in matching if b[3].get(bkey)]
+        if bval is None or not b_bases:
+            print(f"{bkey}: artifact or committed baseline missing the "
+                  "metric — nothing to gate against (pass)")
+            continue
+        bb_name, _, _, bb_parsed = b_bases[-1]
+        b_base = float(bb_parsed[bkey])
+        bratio = float(bval) / b_base
+        bverdict = (f"{bkey}: {float(bval):.0f} vs {b_base:.0f} trials/s "
+                    f"({bb_name}, {art['backend']}) → {bratio:.3f}x")
+        if bratio < 1.0 - args.threshold:
+            print(f"FAIL {bverdict} — throughput regressed past the "
+                  f"{args.threshold:.0%} threshold")
+            rc = 1
+        else:
+            print(f"OK {bverdict}")
+
+    # the pooled-vs-per-trial speedup holds the same absolute-floor shape
+    # as the GP ratio: CPU is the acceptance substrate (dispatch overhead
+    # is exactly what pooling amortizes; accelerators only widen the win),
+    # other substrates report informationally
+    bspeed = extra.get(BATCH_SPEEDUP_METRIC)
+    if bspeed is None:
+        print(f"{BATCH_SPEEDUP_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif art["backend"] != "tpu" and float(bspeed) < BATCH_SPEEDUP_FLOOR:
+        print(f"FAIL {BATCH_SPEEDUP_METRIC}: {float(bspeed):.2f}x < the "
+              f"{BATCH_SPEEDUP_FLOOR:.0f}x acceptance floor")
+        rc = 1
+    else:
+        print(f"OK {BATCH_SPEEDUP_METRIC}: {float(bspeed):.2f}x "
+              f"(floor {BATCH_SPEEDUP_FLOOR:.0f}x on cpu)")
 
     # suggest-ahead hit rates: higher is better, gated inversely against
     # the last baseline that carries them (informational until then)

@@ -239,6 +239,14 @@ class BaseAlgorithm(ABC):
         return self.n_observed >= self.space.cardinality
 
     # -- optional hooks ----------------------------------------------------
+    @property
+    def cohort_size(self) -> Optional[int]:
+        """Natural same-fidelity evaluation-pool size, if the algorithm
+        has one (population algorithms: their generation). The batched
+        hunt (``workon(batch_size="auto")``) sizes its pools from this so
+        a whole generation evaluates as one device program."""
+        return None
+
     def score(self, point: Dict[str, Any]) -> float:
         """Rank candidate points (higher is better); default indifferent."""
         return 0.0

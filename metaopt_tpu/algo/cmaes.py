@@ -134,6 +134,11 @@ class CMAES(SuggestAhead, BaseAlgorithm):
         self._suggest_ahead_async()
 
     # -- suggest -----------------------------------------------------------
+    @property
+    def cohort_size(self):
+        # λ candidates per generation, all at full fidelity
+        return self.lam
+
     def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
         with self._kernel_lock:
             out: List[Dict[str, Any]] = []

@@ -92,6 +92,11 @@ class EvolutionES(BaseAlgorithm):
             out.append(pt)
         return out
 
+    @property
+    def cohort_size(self):
+        # one generation = one same-fidelity evaluation pool
+        return self.population_size
+
     def _suggest_one(self) -> Optional[Dict[str, Any]]:
         # generation complete? select survivors and advance
         if (

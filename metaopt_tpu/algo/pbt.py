@@ -138,6 +138,11 @@ class PBT(BaseAlgorithm):
             self._seeded = max(self._seeded, len(self._rungs[0]))
 
     # -- suggest -----------------------------------------------------------
+    @property
+    def cohort_size(self):
+        # the population trains rung-by-rung: one same-budget pool
+        return self.population_size
+
     def suggest(self, num: int = 1) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []
         for _ in range(num):

@@ -229,6 +229,7 @@ def default_race_config() -> RaceConfig:
         "CMAES": "metaopt_tpu.algo.cmaes",
         "ShardRouter": "metaopt_tpu.coord.shards",
         "ShardSupervisor": "metaopt_tpu.coord.shards",
+        "BatchedExecutor": "metaopt_tpu.executor.batched",
     }
     rc.race_exempt = {
         ("CoordServer", "_mut"),
@@ -260,6 +261,8 @@ def default_race_config() -> RaceConfig:
         "ShardSupervisor._drain",
         # failover redistribution runs on its own per-dead-shard thread
         "ShardSupervisor._failover_shard",
+        # a shared executor's pool evaluations run on worker threads
+        "BatchedExecutor.execute_batch",
     }
     return rc
 
@@ -295,6 +298,7 @@ def default_config() -> LintConfig:
         "SuggestAhead": {"_ahead_lock"},
         "ShardRouter": {"_conns_lock", "_map_lock"},
         "ShardSupervisor": {"_procs_lock"},
+        "BatchedExecutor": {"_tel_lock"},
     }
     cfg.lock_factories = {
         "_exp_lock": (EXP_LOCK, ["CoordServer._exp_locks_guard"]),
@@ -321,6 +325,9 @@ def default_config() -> LintConfig:
         # read releases it. (CoordServer._map_cv deliberately absent:
         # handoff_prepare WAITS on it for the in-flight drain.)
         "ShardRouter._map_lock",
+        # telemetry counter increments only; the vmap launch itself runs
+        # outside the lock
+        "BatchedExecutor._tel_lock",
     }
     cfg.guarded_attrs = {
         "CoordServer": {
@@ -417,6 +424,13 @@ def default_config() -> LintConfig:
             "_ahead_hits": "SuggestAhead._ahead_lock",
             "_ahead_misses": "SuggestAhead._ahead_lock",
             "_ahead_launches": "SuggestAhead._ahead_lock",
+        },
+        "BatchedExecutor": {
+            # launch/row/pool telemetry: one executor may be shared by
+            # several batched workers, and telemetry() reads cross-thread
+            "_launches": "BatchedExecutor._tel_lock",
+            "_rows": "BatchedExecutor._tel_lock",
+            "_pools": "BatchedExecutor._tel_lock",
         },
     }
     cfg.receiver_roles = {

@@ -17,7 +17,12 @@ shared state while instrumented:
   third phase bounces a live experiment between two shards with
   ``sup.handoff`` under concurrent writers: the client's monotonic map
   adoption + ``Migrating`` retry loop, the router's table swap under
-  ``_map_lock``, and the supervisor's committed-map bookkeeping race.
+  ``_map_lock``, and the supervisor's committed-map bookkeeping race. A
+  fourth phase runs two batched workers (``workon(batch_size=...)``)
+  sharing ONE :class:`BatchedExecutor` against an algorithm-hosting
+  server: the fused multi-trial ``complete`` leg, the reservation race
+  for pool slots, and the executor's launch telemetry under
+  ``_tel_lock``.
 * ``algo`` — CMA-ES (numpy-only: no compile cost inside the detector)
   with ``suggest_prefetch_depth=2``, a driver thread running
   suggest/observe generations against the SuggestAhead refill thread,
@@ -106,6 +111,7 @@ def suite_coord(scale: int = 1) -> None:
                 raise errors[0]
     _coord_sharded_phase(scale)
     _coord_handoff_phase(scale)
+    _coord_batched_phase(scale)
 
 
 def _coord_sharded_phase(scale: int = 1) -> None:
@@ -268,6 +274,59 @@ def _coord_handoff_phase(scale: int = 1) -> None:
                     t.join(timeout=120.0)
             if errors:
                 raise errors[0]
+
+
+def _coord_batched_phase(scale: int = 1) -> None:
+    """Batched-worker leg of the coord suite: two ``workon`` loops with
+    ``batch_size=4`` share ONE :class:`BatchedExecutor` against a live
+    algorithm-hosting server. The surface under test is the fused
+    multi-trial ``complete`` leg (``completed_oks`` vs the reply cache),
+    the cross-worker reservation race for pool slots, and the executor's
+    launch/row telemetry counters under ``_tel_lock``. The objective is a
+    one-liner so the jit compile inside the instrumented region stays
+    cheap."""
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+    from metaopt_tpu.executor import BatchedExecutor
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+    from metaopt_tpu.worker.loop import workon
+
+    import jax.numpy as jnp
+
+    budget = 16 * scale
+    with CoordServer(host_algorithms=True, stale_timeout_s=5.0,
+                     sweep_interval_s=0.1) as s:
+        host, port = s.address
+        c0 = CoordLedgerClient(host=host, port=port)
+        c0.create_experiment({
+            "name": "race-batched", "space": {"x": "uniform(-5, 5)"},
+            "max_trials": budget, "pool_size": 4,
+            "algorithm": {"random": {"seed": 7}},
+        })
+        space = build_space({"x": "uniform(-5, 5)"})
+        shared_ex = BatchedExecutor(
+            lambda cols: (jnp.asarray(cols["x"]) - 1.0) ** 2, space)
+        errors: List[BaseException] = []
+
+        def worker(i: int) -> None:
+            try:
+                c = CoordLedgerClient(host=host, port=port)
+                exp = Experiment("race-batched", c).configure()
+                workon(exp, shared_ex, worker_id=f"bw{i}",
+                       producer_mode="coord", batch_size=4,
+                       max_idle_cycles=100)
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    name=f"race-batched-worker-{i}")
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        if errors:
+            raise errors[0]
 
 
 def suite_algo(scale: int = 1) -> None:

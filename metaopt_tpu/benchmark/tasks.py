@@ -6,12 +6,17 @@ objective plus a search-space declaration and a trial budget, so a
 :class:`~metaopt_tpu.benchmark.Benchmark` can run algorithm comparisons
 without any user script. The functions are the classic public test
 objectives (Rosenbrock, Branin, Sphere, Rastrigin).
+
+The four classics also expose a ``batch(cols)`` vectorized variant — pure
+``jnp`` over ``(B,)`` columns (the :meth:`Space.stack_points` layout, or a
+``(B, d)`` matrix) — so a :class:`~metaopt_tpu.executor.BatchedExecutor`
+can evaluate an entire suggestion pool as one compiled device program.
 """
 
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Mapping
 
 from metaopt_tpu.utils.registry import Registry
 
@@ -32,6 +37,15 @@ class BenchmarkTask:
         raise NotImplementedError
 
     @property
+    def vectorized(self) -> bool:
+        """True when this task overrides ``batch`` with a jnp column form."""
+        return type(self).batch is not BenchmarkTask.batch
+
+    def batch(self, cols):
+        """Vectorized objective: ``(B,)`` columns → ``(B,)`` values."""
+        raise NotImplementedError(f"{self.name} has no vectorized form")
+
+    @property
     def name(self) -> str:
         return type(self).__name__.lower()
 
@@ -42,6 +56,21 @@ class BenchmarkTask:
 
 def _objective(value: float) -> List[Dict[str, Any]]:
     return [{"name": "objective", "type": "objective", "value": float(value)}]
+
+
+def _columns(cols, names):
+    """Normalize a stacked pool — ``{name: (B,)}`` dict or ``(B, d)``
+    matrix — into the named column list a batch objective closes over."""
+    import jax.numpy as jnp
+
+    if isinstance(cols, Mapping):
+        return [jnp.asarray(cols[n], dtype=jnp.float32) for n in names]
+    mat = jnp.asarray(cols, dtype=jnp.float32)
+    if mat.ndim != 2 or mat.shape[1] != len(names):
+        raise ValueError(
+            f"expected (B, {len(names)}) matrix or column dict, got {mat.shape}"
+        )
+    return [mat[:, i] for i in range(len(names))]
 
 
 @task_registry.register("rosenbrock")
@@ -62,6 +91,13 @@ class RosenBrock(BenchmarkTask):
             100.0 * (x[i + 1] - x[i] ** 2) ** 2 + (1.0 - x[i]) ** 2
             for i in range(self.dim - 1)
         ))
+
+    def batch(self, cols):
+        x = _columns(cols, [f"x{i}" for i in range(self.dim)])
+        return sum(
+            100.0 * (x[i + 1] - x[i] ** 2) ** 2 + (1.0 - x[i]) ** 2
+            for i in range(self.dim - 1)
+        )
 
     @property
     def configuration(self):
@@ -87,6 +123,19 @@ class Branin(BenchmarkTask):
             + s * (1 - t) * math.cos(x0) + s
         )
 
+    def batch(self, cols):
+        import jax.numpy as jnp
+
+        x0, x1 = _columns(cols, ["x0", "x1"])
+        b = 5.1 / (4 * math.pi ** 2)
+        c = 5.0 / math.pi
+        s = 10.0
+        t = 1.0 / (8 * math.pi)
+        return (
+            (x1 - b * x0 ** 2 + c * x0 - 6.0) ** 2
+            + s * (1 - t) * jnp.cos(x0) + s
+        )
+
 
 @task_registry.register("sphere")
 class Sphere(BenchmarkTask):
@@ -104,6 +153,10 @@ class Sphere(BenchmarkTask):
         return _objective(sum(
             params[f"x{i}"] ** 2 for i in range(self.dim)
         ))
+
+    def batch(self, cols):
+        x = _columns(cols, [f"x{i}" for i in range(self.dim)])
+        return sum(c ** 2 for c in x)
 
     @property
     def configuration(self):
@@ -165,6 +218,14 @@ class Rastrigin(BenchmarkTask):
             - 10.0 * math.cos(2 * math.pi * params[f"x{i}"])
             for i in range(self.dim)
         ))
+
+    def batch(self, cols):
+        import jax.numpy as jnp
+
+        x = _columns(cols, [f"x{i}" for i in range(self.dim)])
+        return 10.0 * self.dim + sum(
+            c ** 2 - 10.0 * jnp.cos(2 * math.pi * c) for c in x
+        )
 
     @property
     def configuration(self):

@@ -107,6 +107,19 @@ def build_parser() -> argparse.ArgumentParser:
                            "all trials: trial N reuses trial 1's compile "
                            "(don't share the dir across heterogeneous "
                            "hosts)")
+    hunt.add_argument("--batch-size", dest="batch_size", default=None,
+                      help="evaluate pools of this many trials as ONE "
+                           "jitted vmap program (needs --vector-objective; "
+                           "'auto' sizes pools from the algorithm's "
+                           "population cohort)")
+    hunt.add_argument("--vector-objective", dest="vector_objective",
+                      default=None,
+                      help="named vectorized in-process objective for the "
+                           "batched hunt: a benchmark task with a batch() "
+                           "form (rosenbrock/branin/sphere/rastrigin) or "
+                           "'mlp' (the vmapped zoo train objective); "
+                           "without a user command the space comes from "
+                           "the objective")
     hunt.add_argument("cmd", nargs=argparse.REMAINDER,
                       help="user script and its args with ~priors")
 
@@ -629,9 +642,46 @@ def _experiment_from_args(args, cfg: Dict[str, Any], need_cmd: bool):
     return exp, template
 
 
+def _vector_objective(name: str):
+    """Resolve a named vectorized objective to (batch_fn, space DSL)."""
+    from metaopt_tpu.models import objectives as zoo
+
+    if name == "mlp":
+        return zoo.make_mlp_batch_objective(), dict(zoo.MLP_SPACE)
+    from metaopt_tpu.benchmark.tasks import task_registry
+
+    try:
+        task = task_registry.get(name)()
+    except KeyError:
+        raise SystemExit(
+            f"unknown vectorized objective {name!r} (benchmark task "
+            "with a batch() form, or 'mlp')"
+        )
+    if not task.vectorized:
+        raise SystemExit(f"benchmark task {name!r} has no vectorized form")
+    return task.batch, dict(task.space)
+
+
 def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
+    batch_size = getattr(args, "batch_size", None) or cfg.get("batch_size")
+    vector_name = (getattr(args, "vector_objective", None)
+                   or cfg.get("vector_objective"))
+    if batch_size not in (None, 1, "1") and not vector_name:
+        raise SystemExit(
+            "--batch-size needs --vector-objective NAME: pools evaluate "
+            "in-process as one vmap program, subprocess trials can't batch"
+        )
+    vector_fn = None
+    if vector_name:
+        vector_fn, vector_space = _vector_objective(vector_name)
+        if not _strip_remainder(getattr(args, "cmd", []) or []):
+            # no user command: the objective is in-process anyway, so the
+            # space comes from its declaration (~prior tokens, never run)
+            args.cmd = [f"batched:{vector_name}"] + [
+                f"{k}~{v}" for k, v in vector_space.items()
+            ]
     exp, template = _experiment_from_args(args, cfg, need_cmd=False)
-    if template is None or not exp.user_args:
+    if vector_fn is None and (template is None or not exp.user_args):
         raise SystemExit("hunt needs a user command (or an experiment that has one)")
 
     script = template.argv[0] if template.argv else ""
@@ -644,6 +694,14 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
     )
 
     def make_executor(tmpl):
+        if vector_fn is not None:
+            from metaopt_tpu.executor import BatchedExecutor
+
+            if exp.space is None:
+                raise SystemExit(
+                    "batched hunt needs a space (none stored or declared)"
+                )
+            return BatchedExecutor(vector_fn, exp.space)
         kwargs = dict(
             working_dir=args.working_dir or cfg.get("working_dir"),
             interpreter=interpreter,
@@ -668,6 +726,11 @@ def _cmd_hunt(args, cfg: Dict[str, Any]) -> int:
         heartbeat_timeout_s=cfg.get("heartbeat_s", 30.0) * 2,
         producer_mode=args.producer or cfg.get("producer") or "local",
     )
+    if vector_fn is not None:
+        # in-process vectorized objective: default to cohort-sized pools
+        workon_kwargs["batch_size"] = (
+            "auto" if batch_size in (None, "auto") else int(batch_size)
+        )
     worker_id = args.worker_id or f"{os.uname().nodename}-{os.getpid()}"
     n_workers = max(1, int(getattr(args, "n_workers", 1) or 1))
     if n_workers == 1:

@@ -573,7 +573,32 @@ class CoordLedgerClient(LedgerBackend):
         doctrine: the op is taken only when the server advertises it (ping
         ``caps``) and still degrades per-op on "unknown op", so mixed-
         version pods keep working in both directions.
+
+        ``complete`` may also carry ``{"trials": [docs...]}`` — the batched
+        hunt's whole-pool push; the reply's ``completed_oks`` is positional.
+        Against a server without the ``worker_cycle_multi`` cap the pushes
+        degrade to per-trial ``update_trial`` RPCs before the cycle.
         """
+        if (complete and complete.get("trials") is not None
+                and not self._has_cap("worker_cycle_multi")):
+            # old server: the multi-push leg would be silently dropped —
+            # apply it as plain update_trial calls, then cycle without it
+            oks = [
+                bool(self._call(
+                    "update_trial", trial=doc,
+                    expected_status=complete.get("expected_status", "reserved"),
+                    expected_worker=complete.get("expected_worker"),
+                ))
+                for doc in complete["trials"]
+            ]
+            for doc in complete["trials"]:
+                self._untrack(experiment, doc["id"])
+            r = self.worker_cycle(
+                experiment, worker, pool_size=pool_size,
+                stale_timeout_s=stale_timeout_s, produce=produce,
+            )
+            r["completed_oks"] = oks
+            return r
         if self._has_cap("worker_cycle"):
             try:
                 r = self._call(
@@ -591,9 +616,14 @@ class CoordLedgerClient(LedgerBackend):
                         c for c in (self._caps or ()) if c != "worker_cycle"
                     )
             else:
-                if complete and r.get("completed_ok") is not None:
-                    # the deferred push leg ended our hold either way
-                    # (applied, or lost to another owner)
+                if complete and r.get("completed_oks") is not None:
+                    for doc in (complete.get("trials")
+                                or [complete["trial"]]):
+                        # the push leg ended our hold either way
+                        # (applied, or lost to another owner)
+                        self._untrack(experiment, doc["id"])
+                elif complete and r.get("completed_ok") is not None:
+                    # pre-``completed_oks`` server: single-trial reply only
                     self._untrack(experiment, complete["trial"]["id"])
                 r["trial"] = (
                     Trial.from_dict(r["trial"]) if r.get("trial") else None

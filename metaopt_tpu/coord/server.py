@@ -100,7 +100,10 @@ log = logging.getLogger(__name__)
 
 #: optional ops this build serves, advertised in the ``ping`` reply so a
 #: client can pick its fast paths up front instead of probe-by-error
-CAPS = ("count", "fetch_completed_since", "worker_cycle")
+CAPS = ("count", "fetch_completed_since", "worker_cycle",
+        # worker_cycle's complete leg accepts {"trials": [...]} — the
+        # batched hunt pushes a whole evaluated pool in one cycle
+        "worker_cycle_multi")
 
 
 class _ShardedLedger:
@@ -1130,15 +1133,27 @@ class CoordServer:
             out["algo_passive"] = getattr(entry[0], "algo_passive", False)
         complete = a.get("complete")
         if complete:
-            t = Trial.from_dict(complete["trial"])
-            out["completed_ok"] = bool(self.ledger.update_trial(
-                t,
-                expected_status=complete.get("expected_status", "reserved"),
-                expected_worker=complete.get("expected_worker"),
-            ))
-            if out["completed_ok"]:
-                self._event("update_trial", name, trial=t.id,
-                            status=t.status)
+            # single-trial ("trial") and multi-trial ("trials", the batched
+            # hunt's whole-pool push) forms; oks are positional either way
+            docs = complete.get("trials")
+            single = docs is None
+            if single:
+                docs = [complete["trial"]]
+            oks = []
+            for doc_t in docs:
+                t = Trial.from_dict(doc_t)
+                ok = bool(self.ledger.update_trial(
+                    t,
+                    expected_status=complete.get("expected_status", "reserved"),
+                    expected_worker=complete.get("expected_worker"),
+                ))
+                oks.append(ok)
+                if ok:
+                    self._event("update_trial", name, trial=t.id,
+                                status=t.status)
+            out["completed_oks"] = oks
+            if single:
+                out["completed_ok"] = oks[0]
         timeout_s = a.get("stale_timeout_s")
         if timeout_s is not None:
             released = self.ledger.release_stale(name, float(timeout_s))

@@ -10,16 +10,21 @@ subprocesses the script, and reads back the results JSON. Executors here:
   (argv/config materialization + report_results handshake + heartbeats +
   the ``judge`` early-stop poll over ``report_partial`` streams),
 - :class:`TPUExecutor` (:mod:`metaopt_tpu.executor.tpu`) — subprocess
-  execution with chip / ICI-sub-slice pinning and gang scheduling.
+  execution with chip / ICI-sub-slice pinning and gang scheduling,
+- :class:`BatchedExecutor` (:mod:`metaopt_tpu.executor.batched`) — a whole
+  suggestion pool evaluated as one jitted ``vmap`` program over stacked
+  hyperparameter columns (vectorizable spaces only).
 """
 
 from metaopt_tpu.executor.base import ExecutionResult, Executor
+from metaopt_tpu.executor.batched import BatchedExecutor
 from metaopt_tpu.executor.inprocess import InProcessExecutor
 from metaopt_tpu.executor.subproc import SubprocessExecutor
 
 __all__ = [
     "Executor",
     "ExecutionResult",
+    "BatchedExecutor",
     "InProcessExecutor",
     "SubprocessExecutor",
 ]

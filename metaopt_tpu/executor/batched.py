@@ -1,0 +1,198 @@
+"""Batched executor: a whole suggestion pool as one device program.
+
+No reference equivalent — the lineage treats every trial as an opaque
+subprocess. Here, for vectorizable spaces (``Space.vectorizable()``) and
+vectorized objectives (``benchmark/tasks.py`` ``batch`` forms, the
+``models/objectives.py`` vmapped zoo), an entire pool of reserved trials
+stacks into per-dimension device columns and evaluates as a *single*
+jitted launch, so population HPO (EvolutionES / PBT / CMA-ES generations,
+ASHA rungs) is FLOPs-bound instead of dispatch-bound.
+
+Semantics, relative to :class:`InProcessExecutor`:
+
+- **Failure isolation is per trial.** A NaN/inf row marks *that* trial
+  ``broken``; its batch siblings still complete. A failure to stack or
+  trace (heterogeneous pool, objective raising) breaks the affected
+  chunk only, never the worker.
+- **Fidelity cohorts.** The single fidelity dim must be constant per
+  launch; a mixed-fidelity pool is split into per-rung sub-batches
+  (ASHA hands workers exactly such cohorts).
+- **Heartbeats still matter.** Each trial's heartbeat is checked before
+  its chunk launches and again after results land, so a reservation the
+  sweeper reclaimed mid-flight is reported ``interrupted`` — the batch
+  never complete-stomps a reassigned trial.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from metaopt_tpu.executor.base import ExecutionResult, Executor, HeartbeatFn, JudgeFn
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space.space import Space
+
+#: vectorized objective: ``{name: (B,) column}`` → ``(B,)`` values
+BatchObjectiveFn = Callable[[Mapping[str, Any]], Any]
+
+
+def _make_kernel(batch_fn: BatchObjectiveFn):
+    """Close the vectorized objective into the fused pool-eval kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    # mtpu: hotpath
+    def pool_eval(cols):
+        """One launch per pool: objective values for every row at once."""
+        out = jnp.asarray(batch_fn(cols))
+        return jnp.reshape(out.astype(jnp.float32), (-1,))
+
+    return jax.jit(pool_eval)
+
+
+class BatchedExecutor(Executor):
+    """Evaluates pools of trials through a single jitted ``vmap`` program.
+
+    ``batch_fn`` takes the :meth:`Space.stack_points` column layout and
+    returns a ``(B,)`` value vector; ``space`` proves the pool is
+    batchable (and does the stacking) before anything traces.
+    ``chunk_size`` bounds one launch — heartbeats are re-checked between
+    chunks so a long pool can still abort early.
+    """
+
+    def __init__(
+        self,
+        batch_fn: BatchObjectiveFn,
+        space: Space,
+        *,
+        chunk_size: Optional[int] = None,
+        result_name: str = "objective",
+    ):
+        reason = space.why_not_vectorizable()
+        if reason is not None:
+            raise ValueError(f"space is not vectorizable: {reason}")
+        if chunk_size is not None and chunk_size < 1:
+            raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+        self.batch_fn = batch_fn
+        self.space = space
+        self.chunk_size = chunk_size
+        self.result_name = result_name
+        self._kernel = _make_kernel(batch_fn)
+        # telemetry counters; executors are shared across worker threads
+        # in batched hunts, so bookkeeping takes the lock
+        self._tel_lock = threading.Lock()
+        self._launches = 0
+        self._rows = 0
+        self._pools = 0
+
+    # -- telemetry ---------------------------------------------------------
+    def telemetry(self) -> Dict[str, int]:
+        with self._tel_lock:
+            return {
+                "kernel_launches": self._launches,
+                "rows_evaluated": self._rows,
+                "pools": self._pools,
+            }
+
+    # -- Executor contract -------------------------------------------------
+    def execute(
+        self,
+        trial: Trial,
+        heartbeat: Optional[HeartbeatFn] = None,
+        judge: Optional[JudgeFn] = None,
+    ) -> ExecutionResult:
+        return self.execute_batch([trial], heartbeats=[heartbeat], judge=judge)[0]
+
+    def execute_batch(
+        self,
+        trials: Sequence[Trial],
+        heartbeats: Optional[Sequence[Optional[HeartbeatFn]]] = None,
+        judge: Optional[JudgeFn] = None,
+    ) -> List[ExecutionResult]:
+        """Evaluate a pool; returns one :class:`ExecutionResult` per trial.
+
+        ``judge`` is accepted for interface parity but unused: a batched
+        pool completes as a unit, there is no partial-results stream to
+        prune against.
+        """
+        n = len(trials)
+        if heartbeats is None:
+            heartbeats = [None] * n
+        if len(heartbeats) != n:
+            raise ValueError(f"{n} trials but {len(heartbeats)} heartbeats")
+        out: List[Optional[ExecutionResult]] = [None] * n
+
+        fid = self.space.fidelity
+        # per-rung cohorts: one launch may only hold one budget level
+        groups: Dict[Any, List[int]] = {}
+        for i, t in enumerate(trials):
+            key = t.params.get(fid.name) if fid is not None else None
+            groups.setdefault(key, []).append(i)
+
+        for idxs in groups.values():
+            step = self.chunk_size or len(idxs)
+            for start in range(0, len(idxs), step):
+                chunk = idxs[start:start + step]
+                for i, res in zip(chunk, self._run_chunk(trials, heartbeats, chunk)):
+                    out[i] = res
+        return out  # type: ignore[return-value]  # every index was assigned
+
+    # -- internals ---------------------------------------------------------
+    def _run_chunk(
+        self,
+        trials: Sequence[Trial],
+        heartbeats: Sequence[Optional[HeartbeatFn]],
+        chunk: List[int],
+    ) -> List[ExecutionResult]:
+        """One launch: stack → fused kernel → fan results back out."""
+        results: Dict[int, ExecutionResult] = {}
+        live: List[int] = []
+        for i in chunk:
+            hb = heartbeats[i]
+            if hb is not None and not hb():
+                results[i] = ExecutionResult("interrupted", note="lost reservation")
+            else:
+                live.append(i)
+        if live:
+            try:
+                cols, _ = self.space.stack_points([trials[i].params for i in live])
+                values = np.asarray(self._kernel(cols), dtype=np.float64)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # a broken chunk must not kill the worker
+                note = f"{type(e).__name__}: {e}"
+                for i in live:
+                    results[i] = ExecutionResult("broken", note=note)
+                live = []
+            else:
+                with self._tel_lock:
+                    self._launches += 1
+                    self._rows += len(live)
+                    self._pools += 1
+        for row, i in enumerate(live):
+            hb = heartbeats[i]
+            if hb is not None and not hb():
+                # reservation reclaimed while the pool ran: the result is
+                # stale, some other worker owns this trial now
+                results[i] = ExecutionResult(
+                    "interrupted", note="lost reservation during evaluation"
+                )
+                continue
+            v = float(values[row])
+            if not np.isfinite(v):
+                results[i] = ExecutionResult(
+                    "broken", note=f"non-finite objective: {v}"
+                )
+            else:
+                results[i] = ExecutionResult(
+                    "completed",
+                    results=[{
+                        "name": self.result_name,
+                        "type": "objective",
+                        "value": v,
+                    }],
+                    exit_code=0,
+                )
+        return [results[i] for i in chunk]

@@ -38,4 +38,11 @@ class InProcessExecutor(Executor):
             results = [{"name": "objective", "type": "objective", "value": float(out)}]
         else:
             results = [dict(r) for r in out]
+        # re-check after evaluation: a reservation lost *while* fn ran means
+        # the sweeper already reassigned this trial — completing it now
+        # would stomp the other worker's run with a stale result.
+        if heartbeat is not None and not heartbeat():
+            return ExecutionResult(
+                "interrupted", note="lost reservation during evaluation"
+            )
         return ExecutionResult("completed", results=results, exit_code=0)

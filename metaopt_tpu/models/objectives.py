@@ -43,3 +43,130 @@ def make_objective(name: str) -> Callable[[Dict[str, Any]], float]:
         "branin": branin,
     }
     return table[name]
+
+
+# -- vectorized zoo -------------------------------------------------------
+#
+# Column-form variants for the BatchedExecutor: each takes the
+# ``Space.stack_points`` layout ``{name: (B,) array}`` and returns a
+# ``(B,)`` value vector in pure jnp, so an entire suggestion pool traces
+# into one device program. The mlp objective is the "zoo" flavor: a tiny
+# regression net whose *init and train steps* are vmapped over the
+# hyperparameter axis — k trials train as one compiled program.
+
+#: search-space DSL for the vmapped mlp train objective
+MLP_SPACE: Dict[str, str] = {
+    "lr": "loguniform(0.001, 1.0)",
+    "init": "uniform(0.1, 2.0)",
+}
+
+
+def rosenbrock_batch(cols) -> Any:
+    """Column form of :func:`rosenbrock` over ``{'x','y'}``."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(cols["x"], jnp.float32)
+    y = jnp.asarray(cols["y"], jnp.float32)
+    return (1.0 - x) ** 2 + 100.0 * (y - x * x) ** 2
+
+
+def sphere_batch(cols) -> Any:
+    """Column form of :func:`sphere` over any all-real column dict."""
+    import jax.numpy as jnp
+
+    return sum(jnp.asarray(c, jnp.float32) ** 2 for c in cols.values())
+
+
+def branin_batch(cols) -> Any:
+    """Column form of :func:`branin` over ``{'x','y'}``."""
+    import math
+
+    import jax.numpy as jnp
+
+    x = jnp.asarray(cols["x"], jnp.float32)
+    y = jnp.asarray(cols["y"], jnp.float32)
+    b, c = 5.1 / (4 * math.pi ** 2), 5.0 / math.pi
+    s, t = 10.0, 1.0 / (8 * math.pi)
+    return (y - b * x * x + c * x - 6.0) ** 2 + s * (1 - t) * jnp.cos(x) + s
+
+
+def _mlp_core(width: int, steps: int, n: int, d: int):
+    """Scalar train core: (lr, init_scale) → final train loss.
+
+    Everything inside is jnp on a fixed synthetic regression set (seeded
+    PRNG folds to constants at trace time), so the core is both jittable
+    per-trial and vmappable over the hyperparameter axis.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def core(lr, init_scale):
+        kx, kt, k1, k2 = jax.random.split(jax.random.PRNGKey(0), 4)
+        X = jax.random.normal(kx, (n, d), jnp.float32)
+        y = jnp.tanh(X @ jax.random.normal(kt, (d,), jnp.float32))
+        params = {
+            "W1": jax.random.normal(k1, (d, width), jnp.float32) * init_scale,
+            "b1": jnp.zeros(width, jnp.float32),
+            "w2": jax.random.normal(k2, (width,), jnp.float32) * init_scale,
+            "b2": jnp.float32(0.0),
+        }
+
+        def loss(p):
+            h = jnp.tanh(X @ p["W1"] + p["b1"])
+            return jnp.mean((h @ p["w2"] + p["b2"] - y) ** 2)
+
+        def step(p, _):
+            g = jax.grad(loss)(p)
+            return jax.tree_util.tree_map(lambda a, ga: a - lr * ga, p, g), None
+
+        params, _ = jax.lax.scan(step, params, None, length=steps)
+        return loss(params)
+
+    return core
+
+
+def make_mlp_objective(
+    width: int = 16, steps: int = 12, n: int = 64, d: int = 8
+) -> Callable[[Dict[str, Any]], float]:
+    """Per-trial zoo objective: one jitted dispatch per evaluation."""
+    import jax
+    import jax.numpy as jnp
+
+    jitted = jax.jit(_mlp_core(width, steps, n, d))
+
+    def objective(params: Dict[str, Any]) -> float:
+        return float(jitted(
+            jnp.float32(params["lr"]), jnp.float32(params["init"])
+        ))
+
+    return objective
+
+
+def make_mlp_batch_objective(
+    width: int = 16, steps: int = 12, n: int = 64, d: int = 8
+):
+    """Vectorized zoo objective: vmapped init+train over the pool axis."""
+    import jax
+    import jax.numpy as jnp
+
+    vcore = jax.vmap(_mlp_core(width, steps, n, d))
+
+    def batch(cols):
+        return vcore(
+            jnp.asarray(cols["lr"], jnp.float32),
+            jnp.asarray(cols["init"], jnp.float32),
+        )
+
+    return batch
+
+
+def make_batch_objective(name: str):
+    """Vectorized objective lookup, mirroring :func:`make_objective`."""
+    if name == "mlp":
+        return make_mlp_batch_objective()
+    table = {
+        "rosenbrock": rosenbrock_batch,
+        "sphere": sphere_batch,
+        "branin": branin_batch,
+    }
+    return table[name]

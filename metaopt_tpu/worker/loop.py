@@ -55,6 +55,7 @@ def workon(
     producer_mode: str = "local",
     stop_event: Optional[Any] = None,
     stale_sweep_interval_s: float = 2.0,
+    batch_size: Any = 1,
 ) -> WorkerStats:
     """Run trials until the experiment finishes (or this worker's cap hits).
 
@@ -75,6 +76,14 @@ def workon(
     ``heartbeat_timeout_s`` old by definition, so per-cycle sweeping buys
     nothing and costs an RPC/lock round-trip per cycle; the first cycle
     always sweeps (a restart must free its dead predecessor's holds).
+
+    ``batch_size > 1`` switches to the batched hunt: up to that many
+    reserved trials evaluate as ONE call into the executor's
+    ``execute_batch`` (a single device program on a
+    :class:`~metaopt_tpu.executor.BatchedExecutor`), with completions
+    pushed back in one fused-cycle leg. ``"auto"`` sizes the batch from
+    the algorithm's population cohort (``BaseAlgorithm.cohort_size``)
+    when it has one.
     """
     algo: Optional[BaseAlgorithm]
     if producer_mode == "coord":
@@ -85,6 +94,24 @@ def workon(
         producer = Producer(experiment, algo)
     else:
         raise ValueError(f"unknown producer_mode {producer_mode!r}")
+    if batch_size == "auto":
+        # population algorithms emit same-fidelity generations — the natural
+        # pool; non-cohort algorithms (or the remote producer, whose algo
+        # lives server-side) fall back to the experiment's suggest pool
+        cohort = algo.cohort_size if algo is not None else None
+        batch_size = cohort or max(int(experiment.pool_size or 1), 8)
+    batch_size = int(batch_size)
+    if batch_size > 1:
+        if not hasattr(executor, "execute_batch"):
+            raise ValueError(
+                f"batch_size={batch_size} needs an executor with "
+                f"execute_batch (got {type(executor).__name__})"
+            )
+        return _workon_batched(
+            experiment, executor, worker_id, producer, algo,
+            worker_trials, max_broken, heartbeat_timeout_s, idle_sleep_s,
+            max_idle_cycles, stop_event, stale_sweep_interval_s, batch_size,
+        )
     stats = WorkerStats()
     # per-trial requeue budget: a wedge-attributed infrastructure failure
     # releases the trial (ExecutionResult.requeue), but only this many
@@ -433,6 +460,291 @@ def workon(
     _flush_pending()
     # final observe so the algorithm state is current for callers (the
     # coordinator-hosted algorithm observes inside its own produce cycles)
+    if algo is not None:
+        algo.observe(experiment.fetch_completed_trials())
+    stats.producer_timings = dict(producer.timings)
+    return stats
+
+
+def _workon_batched(
+    experiment: Experiment,
+    executor: Executor,
+    worker_id: str,
+    producer: Any,
+    algo: Optional[BaseAlgorithm],
+    worker_trials: Optional[int],
+    max_broken: Optional[int],
+    heartbeat_timeout_s: float,
+    idle_sleep_s: float,
+    max_idle_cycles: int,
+    stop_event: Optional[Any],
+    stale_sweep_interval_s: float,
+    batch_size: int,
+) -> WorkerStats:
+    """The batched hunt: pools of trials through ``executor.execute_batch``.
+
+    Each outer iteration reserves up to ``batch_size`` trials — on the
+    coord backend through repeated fused ``worker_cycle`` calls (the first
+    carries the produce leg and the previous pool's multi-trial result
+    push; the rest are reserve-only) — and evaluates them in ONE executor
+    call, so a population generation or ASHA rung cohort is a single
+    device program. Status handling per trial mirrors the serial loop;
+    completions ride the next cycle's ``complete.trials`` leg so the
+    steady-state coord cost stays ~1 RPC per trial.
+    """
+    stats = WorkerStats()
+    fused = isinstance(producer, RemoteProducer) and hasattr(
+        experiment.ledger, "worker_cycle"
+    )
+    last_cycle: Optional[Dict[str, Any]] = None
+    last_sweep = 0.0
+    last_broken_note = ""
+    #: completed trials awaiting the next cycle's multi-trial complete
+    #: leg — (trial, was_pruned), flushed directly if the loop exits first
+    pending: List[tuple] = []
+
+    def _resolve(flushed: List[tuple], oks: List[bool]) -> None:
+        for (t_done, was_pruned), ok in zip(flushed, oks):
+            if ok:
+                stats.completed += 1
+                stats.pruned += was_pruned
+            else:
+                log.warning(
+                    "%s lost reservation of %s before result push",
+                    worker_id, t_done.id,
+                )
+
+    def _flush_pending() -> None:
+        nonlocal pending
+        flushed, pending = pending, []
+        if flushed:
+            _resolve(flushed, [
+                experiment.ledger.update_trial(
+                    t, expected_status="reserved", expected_worker=worker_id
+                )
+                for t, _ in flushed
+            ])
+
+    def _cycle_done(r: Dict[str, Any]) -> bool:
+        # same snapshot evaluation as the serial loop; our own pool's
+        # completions are at most one cycle behind (they ride the next
+        # cycle's push leg, whose reply refreshes these counts)
+        if r.get("max_trials") is not None:
+            experiment.max_trials = r["max_trials"]
+        c = r["counts"]
+        if c["completed"] >= experiment.max_trials:
+            return True
+        if not r.get("exp_algo_done"):
+            return False
+        return c["new"] + c["reserved"] == 0
+
+    def heartbeat_for(trial: Trial, primed: bool = False):
+        state = {"primed": primed}
+
+        def beat() -> bool:
+            if state["primed"]:
+                state["primed"] = False
+                return True
+            return experiment.ledger.heartbeat(
+                experiment.name, trial.id, worker_id
+            )
+        return beat
+
+    def _park_suspended(trial: Trial) -> None:
+        trial.transition("suspended")
+        experiment.ledger.update_trial(
+            trial, expected_status="reserved", expected_worker=worker_id
+        )
+        stats.suspended += 1
+
+    try:
+        while True:
+            if last_cycle is not None:
+                if _cycle_done(last_cycle):
+                    break
+            elif experiment.is_done:
+                break
+            if stop_event is not None and stop_event.is_set():
+                log.info("%s: stop requested — winding down", worker_id)
+                break
+            if worker_trials is not None and stats.reserved >= worker_trials:
+                log.info(
+                    "%s: worker_trials cap (%d) reached", worker_id,
+                    worker_trials,
+                )
+                break
+            if max_broken is not None and stats.broken >= max_broken:
+                log.error(
+                    "%s: %d trials broke (max_broken=%d) — is the objective "
+                    "runnable? Stopping. Last failure: %s", worker_id,
+                    stats.broken, max_broken, last_broken_note or "(no detail)",
+                )
+                break
+
+            want = batch_size
+            if worker_trials is not None:
+                want = min(want, worker_trials - stats.reserved)
+            now = time.time()
+            sweep = now - last_sweep >= stale_sweep_interval_s
+            batch: List[Trial] = []
+            primed: List[bool] = []
+            produced = 0
+            if fused:
+                first = True
+                while len(batch) < want:
+                    complete = None
+                    if first and pending:
+                        complete = {
+                            "trials": [t.to_dict() for t, _ in pending],
+                            "expected_status": "reserved",
+                            "expected_worker": worker_id,
+                        }
+                    r = producer.cycle(
+                        pool_size=want,
+                        stale_timeout_s=(
+                            heartbeat_timeout_s if sweep and first else None
+                        ),
+                        produce=first,
+                        complete=complete,
+                    )
+                    last_cycle = r
+                    if complete is not None:
+                        flushed, pending = pending, []
+                        oks = r.get("completed_oks")
+                        if oks is None:
+                            # push leg didn't apply (degraded reply): the
+                            # trials are still reserved — flush directly
+                            oks = [
+                                experiment.ledger.update_trial(
+                                    t, expected_status="reserved",
+                                    expected_worker=worker_id,
+                                )
+                                for t, _ in flushed
+                            ]
+                        _resolve(flushed, oks)
+                    if first:
+                        produced = r["registered"]
+                    first = False
+                    t = r["trial"]
+                    if t is None:
+                        break
+                    if r["suspend"]:
+                        _park_suspended(t)
+                        continue
+                    batch.append(t)
+                    primed.append(
+                        bool(r.get("fused")) and r.get("signal") is None
+                    )
+            else:
+                if sweep:
+                    experiment.ledger.release_stale(
+                        experiment.name, heartbeat_timeout_s
+                    )
+                produced = producer.produce(pool_size=want)
+                while len(batch) < want:
+                    t = experiment.reserve_trial(worker_id)
+                    if t is None:
+                        break
+                    if producer.should_suspend(t):
+                        _park_suspended(t)
+                        continue
+                    batch.append(t)
+                    primed.append(False)
+            if sweep:
+                last_sweep = now
+
+            if not batch:
+                in_flight = (
+                    last_cycle["counts"]["reserved"]
+                    if last_cycle is not None
+                    else experiment.count("reserved")
+                )
+                if produced == 0 and in_flight == 0:
+                    stats.idle_cycles += 1
+                    if producer.algo_done or stats.idle_cycles > max_idle_cycles:
+                        log.info("%s: no work producible; stopping", worker_id)
+                        break
+                else:
+                    stats.idle_cycles = 0
+                time.sleep(idle_sleep_s)
+                continue
+
+            stats.idle_cycles = 0
+            stats.reserved += len(batch)
+            log.debug(
+                "%s running pool of %d trials", worker_id, len(batch)
+            )
+            t0 = time.time()
+            try:
+                results = executor.execute_batch(
+                    batch,
+                    heartbeats=[
+                        heartbeat_for(t, primed=p)
+                        for t, p in zip(batch, primed)
+                    ],
+                )
+            except KeyboardInterrupt:
+                for t in batch:
+                    t.transition("interrupted")
+                    experiment.ledger.update_trial(
+                        t, expected_status="reserved",
+                        expected_worker=worker_id,
+                    )
+                    stats.interrupted += 1
+                raise
+            runtime_s = round(time.time() - t0, 4)
+
+            for trial, res in zip(batch, results):
+                trial.exit_code = res.exit_code
+                if res.status == "completed":
+                    if fused:
+                        trial.attach_results(res.results)
+                        trial.transition("completed")
+                        pending.append((trial, int("pruned" in res.note)))
+                    else:
+                        if experiment.push_results(trial, res.results):
+                            stats.completed += 1
+                            stats.pruned += int("pruned" in res.note)
+                        else:
+                            log.warning(
+                                "%s lost reservation of %s before result "
+                                "push", worker_id, trial.id,
+                            )
+                else:
+                    # broken / interrupted (the batched executor never
+                    # requeues: a pool-level infrastructure failure surfaces
+                    # as broken notes, the worker guard handles persistence)
+                    trial.transition(res.status)
+                    experiment.ledger.update_trial(
+                        trial, expected_status="reserved",
+                        expected_worker=worker_id,
+                    )
+                    stats.broken += res.status == "broken"
+                    stats.interrupted += res.status == "interrupted"
+                    if res.status == "broken":
+                        last_broken_note = res.note
+                        if res.note:
+                            log.warning(
+                                "%s: trial %s broken: %s",
+                                worker_id, trial.id[:8], res.note,
+                            )
+                stats.events.append({
+                    "trial": trial.id,
+                    "status": res.status,
+                    "runtime_s": runtime_s,
+                    "note": res.note,
+                    "pool": len(batch),
+                })
+    except BaseException:
+        try:
+            _flush_pending()
+        except Exception:
+            log.warning(
+                "%s: deferred pool push failed during error unwind "
+                "(the stale sweep will re-free the trials)", worker_id,
+            )
+        raise
+    _flush_pending()
     if algo is not None:
         algo.observe(experiment.fetch_completed_trials())
     stats.producer_timings = dict(producer.timings)

@@ -1,0 +1,229 @@
+"""BatchedExecutor + space stacking + vectorized-task parity."""
+
+import numpy as np
+import pytest
+
+from metaopt_tpu.benchmark.tasks import task_registry
+from metaopt_tpu.executor import BatchedExecutor, InProcessExecutor
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.space import build_space
+
+
+def _trials(space, n, seed=0, exp="e"):
+    return [
+        Trial(params=p, experiment=exp)
+        for p in space.sample(n, seed=seed)
+    ]
+
+
+class TestSpaceStacking:
+    def test_vectorizable_scalar_dims(self):
+        space = build_space({
+            "lr": "loguniform(1e-4, 1)",
+            "width": "uniform(4, 64, discrete=True)",
+            "act": "choices(['relu', 'tanh'])",
+            "epochs": "fidelity(1, 8)",
+        })
+        assert space.vectorizable()
+        assert space.why_not_vectorizable() is None
+
+    def test_shaped_dim_opts_out(self):
+        space = build_space({"w": "normal(0, 1, shape=[3])"})
+        assert not space.vectorizable()
+        assert "array-valued" in space.why_not_vectorizable()
+
+    def test_stack_unstack_roundtrip(self):
+        space = build_space({
+            "lr": "loguniform(1e-4, 1)",
+            "k": "uniform(1, 9, discrete=True)",
+            "act": "choices(['relu', 'tanh', 'gelu'])",
+            "epochs": "fidelity(1, 8)",
+        })
+        pts = space.sample(16, seed=3)
+        cols, fid = space.stack_points(pts)
+        assert cols["lr"].dtype == np.float64
+        assert cols["k"].dtype == np.int32
+        assert cols["act"].dtype == np.int32  # option indices, not objects
+        assert "epochs" not in cols and fid == 8
+        back = space.unstack_points(cols, fid)
+        assert back == [
+            {k: (v if k == "act" else pytest.approx(v)) for k, v in p.items()}
+            for p in pts
+        ]
+
+    def test_mixed_fidelity_batch_rejected(self):
+        space = build_space({"x": "uniform(0, 1)", "epochs": "fidelity(1, 8)"})
+        pts = [{"x": 0.1, "epochs": 2}, {"x": 0.2, "epochs": 8}]
+        with pytest.raises(ValueError, match="constant per batch"):
+            space.stack_points(pts)
+
+    def test_stack_rejects_unvectorizable_and_empty(self):
+        shaped = build_space({"w": "normal(0, 1, shape=[2])"})
+        with pytest.raises(ValueError, match="not vectorizable"):
+            shaped.stack_points([{"w": np.zeros(2)}])
+        flat = build_space({"x": "uniform(0, 1)"})
+        with pytest.raises(ValueError, match="empty"):
+            flat.stack_points([])
+
+
+class TestTaskBatchParity:
+    """Satellite: batched values ≡ scalar __call__ across 256 points."""
+
+    @pytest.mark.parametrize(
+        "name,kwargs", [
+            ("rosenbrock", {"dim": 4}),
+            ("branin", {}),
+            ("sphere", {"dim": 3}),
+            ("rastrigin", {"dim": 3}),
+        ],
+    )
+    def test_batch_matches_scalar(self, name, kwargs):
+        task = task_registry.get(name)(**kwargs)
+        assert task.vectorized
+        space = build_space(task.space)
+        pts = space.sample(256, seed=11)
+        cols, _ = space.stack_points(pts)
+        batched = np.asarray(task.batch(cols), dtype=np.float64)
+        scalar = np.asarray([task(p)[0]["value"] for p in pts])
+        np.testing.assert_allclose(batched, scalar, rtol=1e-6, atol=1e-6)
+
+    def test_batch_accepts_matrix_layout(self):
+        task = task_registry.get("sphere")(dim=2)
+        mat = np.asarray([[1.0, 2.0], [3.0, 0.0]])
+        np.testing.assert_allclose(
+            np.asarray(task.batch(mat)), [5.0, 9.0], rtol=1e-6
+        )
+
+    def test_zdt1_has_no_vector_form(self):
+        assert not task_registry.get("zdt1")().vectorized
+
+
+class TestBatchedExecutor:
+    def _setup(self, n=8, dim=3, **kw):
+        task = task_registry.get("sphere")(dim=dim)
+        space = build_space(task.space)
+        return (
+            BatchedExecutor(task.batch, space, **kw),
+            task, space, _trials(space, n, seed=5),
+        )
+
+    def test_pool_is_one_launch_with_parity(self):
+        ex, task, space, trials = self._setup(n=8)
+        results = ex.execute_batch(trials)
+        assert [r.status for r in results] == ["completed"] * 8
+        for t, r in zip(trials, results):
+            assert r.results[0]["value"] == pytest.approx(
+                task(t.params)[0]["value"], rel=1e-6
+            )
+        assert ex.telemetry()["kernel_launches"] == 1
+        assert ex.telemetry()["rows_evaluated"] == 8
+
+    def test_poisoned_batch_isolates_to_one_broken(self):
+        ex, task, space, trials = self._setup(n=6)
+        trials[2].params["x0"] = float("nan")
+        results = ex.execute_batch(trials)
+        statuses = [r.status for r in results]
+        assert statuses[2] == "broken"
+        assert "non-finite" in results[2].note
+        assert statuses[:2] + statuses[3:] == ["completed"] * 5
+        # the whole pool was still ONE launch
+        assert ex.telemetry()["kernel_launches"] == 1
+
+    def test_single_execute_contract(self):
+        ex, task, space, trials = self._setup(n=1)
+        r = ex.execute(trials[0])
+        assert r.status == "completed" and r.exit_code == 0
+
+    def test_heartbeat_checked_between_chunks(self):
+        ex, task, space, trials = self._setup(n=6, chunk_size=2)
+        calls = {"n": 0}
+
+        def beat():
+            # pre-chunk + post-eval checks: fail from the second chunk on
+            calls["n"] += 1
+            return calls["n"] <= 4
+
+        results = ex.execute_batch(trials, heartbeats=[beat] * 6)
+        assert [r.status for r in results[:2]] == ["completed"] * 2
+        assert {r.status for r in results[2:]} == {"interrupted"}
+        # chunks whose trials all lost their reservation never launch
+        assert ex.telemetry()["kernel_launches"] < 3
+
+    def test_lost_reservation_after_eval_never_completes(self):
+        ex, task, space, trials = self._setup(n=2)
+        flips = iter([True, True, False, False])  # pre-checks ok, post fail
+        results = ex.execute_batch(
+            trials, heartbeats=[lambda: next(flips)] * 2
+        )
+        assert {r.status for r in results} == {"interrupted"}
+        assert all("during evaluation" in r.note for r in results)
+
+    def test_mixed_fidelity_pool_splits_into_cohorts(self):
+        space = build_space({
+            "x0": "uniform(-5, 5)", "epochs": "fidelity(1, 8, base=2)",
+        })
+        import jax.numpy as jnp
+
+        ex = BatchedExecutor(lambda cols: jnp.asarray(cols["x0"]) ** 2, space)
+        trials = [
+            Trial(params={"x0": float(i), "epochs": 2 if i < 3 else 8},
+                  experiment="e")
+            for i in range(6)
+        ]
+        results = ex.execute_batch(trials)
+        assert [r.status for r in results] == ["completed"] * 6
+        for i, r in enumerate(results):
+            assert r.results[0]["value"] == pytest.approx(float(i) ** 2)
+        # one launch per fidelity rung, never one per trial
+        assert ex.telemetry()["kernel_launches"] == 2
+
+    def test_objective_exception_breaks_chunk_not_worker(self):
+        space = build_space({"x0": "uniform(0, 1)"})
+
+        def boom(cols):
+            raise RuntimeError("bad trace")
+
+        ex = BatchedExecutor(boom, space)
+        results = ex.execute_batch(_trials(space, 3, seed=1))
+        assert {r.status for r in results} == {"broken"}
+        assert all("bad trace" in r.note for r in results)
+
+    def test_rejects_unvectorizable_space(self):
+        space = build_space({"w": "normal(0, 1, shape=[2])"})
+        with pytest.raises(ValueError, match="not vectorizable"):
+            BatchedExecutor(lambda c: c, space)
+
+
+class TestInProcessHeartbeat:
+    """Satellite: the post-evaluation heartbeat check."""
+
+    def test_flipping_heartbeat_interrupts_after_eval(self):
+        ex = InProcessExecutor(lambda p: 1.0)
+        flips = iter([True, False])
+        r = ex.execute(
+            Trial(params={"x": 0.0}, experiment="e"),
+            heartbeat=lambda: next(flips),
+        )
+        assert r.status == "interrupted"
+        assert "during evaluation" in r.note
+
+    def test_steady_heartbeat_still_completes(self):
+        ex = InProcessExecutor(lambda p: 2.5)
+        r = ex.execute(
+            Trial(params={"x": 0.0}, experiment="e"), heartbeat=lambda: True
+        )
+        assert r.status == "completed"
+        assert r.results[0]["value"] == 2.5
+
+    def test_lost_before_eval_still_interrupts(self):
+        ran = {"n": 0}
+
+        def fn(p):
+            ran["n"] += 1
+            return 0.0
+
+        ex = InProcessExecutor(fn)
+        r = ex.execute(
+            Trial(params={"x": 0.0}, experiment="e"), heartbeat=lambda: False
+        )
+        assert r.status == "interrupted" and ran["n"] == 0
