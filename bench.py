@@ -821,14 +821,33 @@ def main() -> None:
     try:
         from benchmarks.coord_scale import run_scale as coord_run_scale
 
-        coord_reps = sorted(
-            (coord_run_scale(32, "fused", trials_per_worker=16)
-             for _ in range(3)),
-            key=lambda row: row["trials_per_s"] or 0,
-        )
+        # the binary-vs-JSON pair is interleaved WITHIN each repeat with
+        # alternating order (a long-lived process speeds up run over run,
+        # so sequential batches would hand the later codec a systematic
+        # advantage — the same discipline coord_scale.py's own repeat
+        # loop applies); the speedup is the median of per-repeat ratios
+        coord_pairs = []
+        for r in range(3):
+            rep = {}
+            for w in (("auto", "v1") if r % 2 == 0 else ("v1", "auto")):
+                rep[w] = coord_run_scale(32, "fused", trials_per_worker=16,
+                                         wire=w)
+            coord_pairs.append((rep["auto"], rep["v1"]))
+        coord_reps = sorted((f for f, _ in coord_pairs),
+                            key=lambda row: row["trials_per_s"] or 0)
         coord_row = coord_reps[1]
         coord_stats["coord_trials_per_s_32w"] = coord_row["trials_per_s"]
         coord_stats["coord_rpcs_per_trial_32w"] = coord_row["rpcs_per_trial"]
+        coord_stats["coord_wire_bytes_per_trial"] = (
+            coord_row.get("wire_bytes_per_trial"))
+        if coord_row.get("wire") == "v2":
+            ratios = sorted(
+                f["trials_per_s"] / j["trials_per_s"]
+                for f, j in coord_pairs
+                if f["trials_per_s"] and j["trials_per_s"])
+            if ratios:
+                coord_stats["coord_wire_speedup_32w"] = round(
+                    ratios[len(ratios) // 2], 2)
 
         # durability tax + recovery: same fused path with the WAL under
         # it (group-commit fsync before every ack), then a cold restart

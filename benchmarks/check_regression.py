@@ -40,6 +40,14 @@ COORD_METRIC = "coord_trials_per_s_32w"
 #: them; then the WAL tax gates like a regression — lower is better)
 WAL_METRIC = "coord_wal_overhead_pct"
 RECOVERY_METRIC = "coord_recovery_time_s"
+#: binary wire (protocol v2): on-wire bytes per trial at 32 workers
+#: (lower is better, ratio gate — a codec change that bloats frames
+#: shows up here before it shows up in throughput) and the same-run
+#: binary-vs-JSON throughput speedup, which must hold its absolute
+#: acceptance floor wherever the binary wire negotiated at all
+WIRE_BYTES_METRIC = "coord_wire_bytes_per_trial"
+WIRE_SPEEDUP_METRIC = "coord_wire_speedup_32w"
+WIRE_SPEEDUP_FLOOR = 1.15
 #: sharded deployment: per-shard-count throughput (higher is better,
 #: inverse gate like COORD_METRIC) and the 1-shard process tax vs the
 #: in-process durable server (lower is better, pct-point slack like the
@@ -107,9 +115,16 @@ def load_artifact(path: str) -> dict:
 
 def round_baselines() -> list:
     """(round_name, backend, value) for every committed BENCH_r*.json,
-    oldest→newest (names embed the round number, so lexical order works)."""
+    oldest→newest (names embed the round number, so lexical order works).
+
+    ``benchmarks/baseline.json``, when committed, rides last as the
+    newest round: a synthetic baseline capturing bench rows the round
+    records predate, so their "informational until baselined" gates
+    start enforcing without waiting for the next full round."""
     out = []
-    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    paths.append(os.path.join(REPO, "benchmarks", "baseline.json"))
+    for path in paths:
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -199,6 +214,41 @@ def main() -> int:
     if art.get("recovery") is not None:
         print(f"{RECOVERY_METRIC}: {art['recovery']:.2f}s "
               "(informational — cold restore + WAL replay)")
+
+    # binary wire: bytes/trial gates like a latency (lower is better,
+    # ratio threshold) against the last committed baseline carrying it;
+    # the binary-vs-JSON speedup holds its absolute floor whenever the
+    # artifact reports it (absent = the wire never negotiated v2: pass)
+    art_extra0 = art.get("extra") or {}
+    wb_val = art_extra0.get(WIRE_BYTES_METRIC)
+    wb_bases = [b for b in matching if b[3].get(WIRE_BYTES_METRIC)]
+    if wb_val is None or not wb_bases:
+        print(f"{WIRE_BYTES_METRIC}: artifact or committed baseline "
+              "missing the metric — nothing to gate against (pass)")
+    else:
+        wbb_name, _, _, wbb_parsed = wb_bases[-1]
+        wb_base = float(wbb_parsed[WIRE_BYTES_METRIC])
+        wbratio = float(wb_val) / wb_base
+        wbverdict = (f"{WIRE_BYTES_METRIC}: {float(wb_val):.0f} vs "
+                     f"{wb_base:.0f} bytes ({wbb_name}, {art['backend']}) "
+                     f"→ {wbratio:.3f}x")
+        if wbratio > 1.0 + args.threshold:
+            print(f"FAIL {wbverdict} — frames bloated past the "
+                  f"{args.threshold:.0%} threshold")
+            rc = 1
+        else:
+            print(f"OK {wbverdict}")
+    wspeed = art_extra0.get(WIRE_SPEEDUP_METRIC)
+    if wspeed is None:
+        print(f"{WIRE_SPEEDUP_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif float(wspeed) < WIRE_SPEEDUP_FLOOR:
+        print(f"FAIL {WIRE_SPEEDUP_METRIC}: {float(wspeed):.2f}x < the "
+              f"{WIRE_SPEEDUP_FLOOR:.2f}x acceptance floor")
+        rc = 1
+    else:
+        print(f"OK {WIRE_SPEEDUP_METRIC}: {float(wspeed):.2f}x "
+              f"(floor {WIRE_SPEEDUP_FLOOR:.2f}x)")
 
     # live hand-off / failover: lower is better, gated with the wider
     # HANDOFF_SLACK against the last committed baseline carrying each

@@ -56,10 +56,17 @@ overhead vs the in-process server, which the regression gate bounds.
                                      [--trials-per-worker 16]
                                      [--recovery] [--save]
 
+When the binary wire (protocol v2) is available, a "fused-json" config
+rides along automatically: the same fused deployment with the client
+pinned to the JSON codec, interleaved in the same repeat loop, so the
+`wire_v2_vs_json` summary (throughput speedup + bytes/trial both ways)
+is a same-run ratio like every other headline here.
+
 Emits one JSON line per (mode, workers) config:
-  {"mode": ..., "workers": N, "trials": ..., "wall_s": ...,
-   "trials_per_s": ..., "rpc_p50_ms": ..., "rpc_p99_ms": ...,
-   "rpcs_per_trial": ..., "op_counts": {...}}
+  {"mode": ..., "workers": N, "wire": "v1"|"v2", "trials": ...,
+   "wall_s": ..., "trials_per_s": ..., "rpc_p50_ms": ...,
+   "rpc_p99_ms": ..., "rpcs_per_trial": ..., "wire_bytes": ...,
+   "wire_bytes_per_trial": ..., "op_counts": {...}}
 """
 
 from __future__ import annotations
@@ -179,6 +186,7 @@ def run_scale(
     seed: int = 0,
     shards: int = None,
     experiments: int = 1,
+    wire: str = "auto",
 ) -> dict:
     """One config: N threaded workers drain ``experiments`` experiments
     through one coordinator deployment; returns the throughput/latency
@@ -191,6 +199,12 @@ def run_scale(
     ``shards`` subprocess shards (one WAL each) under a ShardSupervisor,
     clients routing directly by the shard map; compare it against an
     in-process mode at the SAME ``experiments`` in the same invocation.
+
+    ``wire`` selects the client codec: ``"auto"`` negotiates the binary
+    v2 wire when the server advertises it, ``"v1"`` pins JSON — the
+    binary-vs-JSON figure is run_scale(wire="auto") against
+    run_scale(wire="v1") in the SAME invocation (serial mode always pins
+    JSON: the pre-change deployment had no binary wire).
     """
     from metaopt_tpu.coord import CoordLedgerClient
     from metaopt_tpu.executor import InProcessExecutor
@@ -225,7 +239,10 @@ def run_scale(
     server.start()
     try:
         host, port = server.address
-        client = TimingClient(host=host, port=port)
+        # the serial baseline is the pre-change deployment end to end:
+        # JSON wire, no negotiation
+        client = TimingClient(host=host, port=port,
+                              wire="v1" if mode == "serial" else wire)
         if mode == "serial":
             # a pre-worker_cycle coordinator advertises only these; the
             # client then composes cycles from the serial RPC sequence
@@ -271,6 +288,7 @@ def run_scale(
         # start the window with an empty collector debt: on a one-core box
         # a GC pause lands entirely inside whichever mode's window it hits
         gc.collect()
+        bytes0 = client.bytes_sent + client.bytes_recv
         t0 = time.perf_counter()
         for i, wexp in enumerate(worker_exps):
             w = threading.Thread(
@@ -289,6 +307,9 @@ def run_scale(
         for w in threads:
             w.join(timeout=300)
         wall = time.perf_counter() - t0
+        # on-wire volume of the measured window (both directions, framing
+        # headers included); the post-window count reads are excluded
+        wire_bytes = client.bytes_sent + client.bytes_recv - bytes0
 
         # measurement reads (this count + the lat snapshot) come AFTER the
         # window closes and are excluded from the RPC accounting
@@ -310,6 +331,7 @@ def run_scale(
         return {
             "mode": mode,
             "workers": workers,
+            "wire": client._wire_for(client._seed),
             **({"shards": shards or 1} if mode == "sharded" else {}),
             **({"experiments": len(names)} if len(names) > 1 else {}),
             "trials": completed,
@@ -321,6 +343,9 @@ def run_scale(
                 1e3 * _percentile(lat_sorted, 0.99), 3) if lat_sorted else None,
             "rpcs": n_calls,
             "rpcs_per_trial": round(steady / completed, 2) if completed else None,
+            "wire_bytes": wire_bytes,
+            "wire_bytes_per_trial": (round(wire_bytes / completed, 1)
+                                     if completed else None),
             "op_counts": ops,
             "enc_cache_hits": (server._enc_hits
                                if mode.startswith("fused") else None),
@@ -520,6 +545,12 @@ def main():
     # baselines inside the SAME repeat loop (ratio doctrine: never compare
     # a sharded number against a baseline from a different invocation)
     configs = [(m, m, {}) for m in args.modes]
+    # binary-vs-JSON: the same fused deployment with the client pinned to
+    # the v1 JSON codec, interleaved in the same repeat loop — the wire
+    # speedup is a same-run ratio like every other headline here
+    from metaopt_tpu.coord.protocol import HAVE_WIRE_V2
+    if HAVE_WIRE_V2 and "fused" in args.modes:
+        configs.append(("fused-json", "fused", {"wire": "v1"}))
     if args.shards:
         exp = args.shard_experiments
         # the sharded figure is meaningless without the same-durability
@@ -582,6 +613,19 @@ def main():
             "serial_trials_per_s": s["trials_per_s"],
             "fused_rpcs_per_trial": f.get("rpcs_per_trial"),
             "serial_rpcs_per_trial": s.get("rpcs_per_trial"),
+        }), flush=True)
+    # the wire tax: binary (negotiated v2) vs pinned-JSON on the same
+    # fused deployment in the same run; bytes/trial rides along so the
+    # size win is visible next to the throughput win
+    j = by.get(("fused-json", widest))
+    if f and j and f.get("trials_per_s") and j.get("trials_per_s"):
+        print(json.dumps({
+            "summary": f"wire_v2_vs_json_{widest}w",
+            "speedup": round(f["trials_per_s"] / j["trials_per_s"], 2),
+            "binary_trials_per_s": f["trials_per_s"],
+            "json_trials_per_s": j["trials_per_s"],
+            "coord_wire_bytes_per_trial": f.get("wire_bytes_per_trial"),
+            "json_wire_bytes_per_trial": j.get("wire_bytes_per_trial"),
         }), flush=True)
     # the durability tax: fused+wal vs fused in the same run — the gate
     # benchmarks/check_regression.py bounds at 10%

@@ -29,6 +29,8 @@ MTJ004     non-static / non-hashable value bound to ``static_argnames``
 MTD001     journaled op whose dispatch branch reaches no journal call
 MTD002     registry drift between protocol registry and server op sets
 MTD003     reply-journaled op whose handler never journals its reply
+MTD004     mutating/journaled op missing from the binary-wire
+           ``WIRE_OPCODES`` table, or a duplicate/reserved opcode value
 =========  ==============================================================
 
 Findings carry ``file:line`` + rule id. A checked-in baseline

@@ -1,4 +1,4 @@
-"""Durability-contract checkers (MTD001-MTD003).
+"""Durability-contract checkers (MTD001-MTD004).
 
 The contract (coord/protocol.py, "Durability semantics"): once the reply
 to a mutating op is on the wire, the mutation and its reply-cache entry
@@ -15,7 +15,15 @@ are fsynced. Statically that decomposes into:
   op is in ``_DURABLE_OPS`` so its reply actually waits on the fsync
   barrier — else **MTD002**;
 * reply-journaled ops (``worker_cycle``) must call ``_journal_reply`` in
-  their ``_handle_<op>`` handler — else **MTD003**.
+  their ``_handle_<op>`` handler — else **MTD003**;
+* the binary wire's opcode table (``WIRE_OPCODES``) must cover every
+  mutating/journaled op (a v2 request for one would otherwise carry the
+  opcode-0 "unknown" hint, losing routing observability for exactly the
+  ops whose retries depend on the reply cache), and opcode values must
+  be unique and nonzero (they are on the wire; 0 is reserved for
+  not-in-table) — else **MTD004**. Modules with no ``WIRE_OPCODES``
+  declaration skip the check: a repo (or fixture) without the binary
+  wire has nothing to drift.
 
 The checker reads both the registry and the server sets from the AST
 (never imports), so fixture modules in tests exercise it hermetically.
@@ -32,6 +40,36 @@ from metaopt_tpu.analysis.registry import LintConfig, registry_frozensets
 _REGISTRY_NAMES = {"JOURNALED_OPS", "REPLY_JOURNALED_OPS",
                    "NESTED_JOURNALED_OPS"}
 _SERVER_SETS = {"_MUTATING_OPS", "_DURABLE_OPS", "_MUTATORS"}
+_WIRE_TABLE_NAME = "WIRE_OPCODES"
+
+
+def _wire_opcodes(modules: List[LintModule]
+                  ) -> Tuple[Optional[Dict[str, int]],
+                             Optional[LintModule], int]:
+    """The binary wire's op→opcode table, parsed from whichever scanned
+    module declares it (``WIRE_OPCODES = {...}``, plain or annotated
+    assignment). None when no module declares one — MTD004 then has
+    nothing to check."""
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt, val = node.target, node.value
+            else:
+                continue
+            if not (isinstance(tgt, ast.Name)
+                    and tgt.id == _WIRE_TABLE_NAME):
+                continue
+            try:
+                d = ast.literal_eval(val)
+            except (ValueError, SyntaxError):
+                continue
+            if isinstance(d, dict) and all(
+                    isinstance(k, str) and isinstance(v, int)
+                    for k, v in d.items()):
+                return d, mod, node.lineno
+    return None, None, 0
 
 
 def _find_registry(modules: List[LintModule], cfg: LintConfig
@@ -204,6 +242,40 @@ def check_durability(modules: List[LintModule], cfg: LintConfig
                 f"(_journal_reply) — retries across a restart "
                 f"double-execute", symbol=f"{server_cls.name}."
                 f"_handle_{op}", detail=f"nojournal|{op}"))
+
+    # binary-wire opcode table vs the durability contract (MTD004)
+    table = cfg.wire_opcodes
+    wire_mod: Optional[LintModule] = None
+    wire_line = cls_line
+    if table is None:
+        table, wire_mod, wire_line = _wire_opcodes(modules)
+    if table is not None:
+        wire_file = wire_mod.relpath if wire_mod else reg_file
+        need = journaled | reply_j | nested_j | mutating
+        for op in sorted(need - set(table)):
+            out.append(Finding(
+                "MTD004", wire_file, wire_line,
+                f"mutating/journaled op {op!r} has no WIRE_OPCODES "
+                f"entry — its binary-wire requests degrade to the "
+                f"opcode-0 'unknown' hint", symbol=_WIRE_TABLE_NAME,
+                detail=f"missing|{op}"))
+        codes: Dict[int, str] = {}
+        for op, code in table.items():
+            if code == 0:
+                out.append(Finding(
+                    "MTD004", wire_file, wire_line,
+                    f"op {op!r} is assigned opcode 0, the reserved "
+                    f"not-in-table value", symbol=_WIRE_TABLE_NAME,
+                    detail=f"reserved|{op}"))
+            elif code in codes:
+                out.append(Finding(
+                    "MTD004", wire_file, wire_line,
+                    f"opcode {code} is assigned to both {codes[code]!r} "
+                    f"and {op!r} — opcodes are on the wire and must be "
+                    f"unique", symbol=_WIRE_TABLE_NAME,
+                    detail=f"dup|{code}"))
+            else:
+                codes[code] = op
     return [f for f in out if not _suppressed(modules, f)]
 
 

@@ -123,6 +123,10 @@ class LintConfig:
     journal_receivers: Set[str] = field(default_factory=lambda: {
         "_wal", "wal",
     })
+    #: binary-wire op→opcode table for MTD004 (None = parse WIRE_OPCODES
+    #: from whichever scanned module declares it; a scan with no
+    #: declaration skips the check)
+    wire_opcodes: Optional[Dict[str, int]] = None
 
 
 def registry_frozensets(mod: LintModule, names: Set[str]
@@ -205,9 +209,10 @@ def default_race_config() -> RaceConfig:
       does I/O at a time); open()/close() are lifecycle phases.
     * ``CoordServer._ops`` — ops-served telemetry snapshot returned by
       ping; GIL-atomic int store, stale reads are the contract.
-    * ``CoordServer._sock`` / ``_threads`` / ``_prev_switchinterval`` /
-      ``_wal`` — start()/stop()/recovery lifecycle attrs, written before
-      serving threads exist or after they are joined. The static check
+    * ``CoordServer._sock`` / ``_uds_sock`` / ``_threads`` /
+      ``_prev_switchinterval`` / ``_wal`` — start()/stop()/recovery
+      lifecycle attrs, written before serving threads exist or after
+      they are joined. The static check
       accuses them because the bare-name call graph resolves any
       ``x.start()`` into ``CoordServer.start`` (and ``self._wal.append``
       counts as a container write to ``_wal``).
@@ -235,6 +240,7 @@ def default_race_config() -> RaceConfig:
         ("CoordServer", "_mut"),
         ("CoordServer", "_ops"),
         ("CoordServer", "_sock"),
+        ("CoordServer", "_uds_sock"),
         ("CoordServer", "_threads"),
         ("CoordServer", "_prev_switchinterval"),
         ("CoordServer", "_wal"),
@@ -292,7 +298,8 @@ def default_config() -> LintConfig:
             "_producers_guard", "_map_cv",
         },
         "WriteAheadLog": {"_buf_lock", "_cv"},
-        "CoordLedgerClient": {"_lock", "_caps_lock", "_live_lock"},
+        "CoordLedgerClient": {"_lock", "_caps_lock", "_live_lock",
+                              "_io_lock"},
         "MemoryLedger": {"_lock"},
         "_ProduceCoalescer": {"_guard"},
         "SuggestAhead": {"_ahead_lock"},
@@ -328,6 +335,9 @@ def default_config() -> LintConfig:
         # telemetry counter increments only; the vmap launch itself runs
         # outside the lock
         "BatchedExecutor._tel_lock",
+        # wire-byte counter increments only; the socket send/recv happen
+        # under _lock, not under this one
+        "CoordLedgerClient._io_lock",
     }
     cfg.guarded_attrs = {
         "CoordServer": {
@@ -383,6 +393,10 @@ def default_config() -> LintConfig:
             # monotonic map-adoption watermark: a stale lower-version
             # ping can never roll the routing back
             "_map_version": "CoordLedgerClient._caps_lock",
+            # wire-v2 telemetry: bytes on the wire including the 4-byte
+            # length header, incremented per exchange from worker threads
+            "bytes_sent": "CoordLedgerClient._io_lock",
+            "bytes_recv": "CoordLedgerClient._io_lock",
         },
         "ShardRouter": {
             # live relay connections: accept thread adds, per-conn threads

@@ -22,7 +22,12 @@ shared state while instrumented:
   sharing ONE :class:`BatchedExecutor` against an algorithm-hosting
   server: the fused multi-trial ``complete`` leg, the reservation race
   for pool slots, and the executor's launch telemetry under
-  ``_tel_lock``.
+  ``_tel_lock``. A fifth phase runs a mixed-wire fleet against one
+  UDS-enabled server — a pinned-JSON client, a binary (wire v2)
+  client, and a UDS-fast-path client concurrently — so the
+  per-address wire/uds caches under ``_caps_lock``, the server's
+  wire-keyed encode cache, and the per-connection codec detection all
+  race across codecs.
 * ``algo`` — CMA-ES (numpy-only: no compile cost inside the detector)
   with ``suggest_prefetch_depth=2``, a driver thread running
   suggest/observe generations against the SuggestAhead refill thread,
@@ -112,6 +117,7 @@ def suite_coord(scale: int = 1) -> None:
     _coord_sharded_phase(scale)
     _coord_handoff_phase(scale)
     _coord_batched_phase(scale)
+    _coord_mixed_wire_phase(scale)
 
 
 def _coord_sharded_phase(scale: int = 1) -> None:
@@ -327,6 +333,78 @@ def _coord_batched_phase(scale: int = 1) -> None:
             t.join(timeout=120.0)
         if errors:
             raise errors[0]
+
+
+def _coord_mixed_wire_phase(scale: int = 1) -> None:
+    """Mixed-wire leg of the coord suite: three client flavors drive one
+    UDS-enabled server concurrently — one pinned to JSON (``wire="v1"``),
+    one negotiating the binary wire over TCP, and one that adopts the
+    advertised Unix-socket fast path. The surface under test is the
+    client wire/uds caches under ``_caps_lock`` (negotiation racing the
+    exchange loop), the server's wire-keyed preserialized-reply cache,
+    and per-connection codec detection when frames of both formats hit
+    the same ledger locks. When msgpack is absent every client degrades
+    to JSON and the phase still runs as a plain 3-client workload."""
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+
+    flavors = 3
+    budget = flavors * 4 * scale
+    with tempfile.TemporaryDirectory() as td:
+        uds = os.path.join(td, "coord.sock")
+        with CoordServer(stale_timeout_s=5.0, sweep_interval_s=0.1,
+                         uds_path=uds) as s:
+            host, port = s.address
+            c0 = CoordLedgerClient(host=host, port=port, wire="v1")
+            Experiment(
+                "race-wire", c0,
+                space=build_space({"x": "uniform(-5, 5)"}),
+                max_trials=budget, pool_size=flavors,
+                algorithm={"random": {"seed": 7}},
+            ).configure()
+            clients = [
+                CoordLedgerClient(host=host, port=port, wire="v1"),
+                CoordLedgerClient(host=host, port=port, wire="auto"),
+                CoordLedgerClient(host=host, port=port, wire="auto"),
+            ]
+            clients[2].ping()  # learn uds_path before the fan-out
+            errors: List[BaseException] = []
+
+            def worker(i: int) -> None:
+                try:
+                    c = clients[i]
+                    complete = None
+                    for _ in range(budget * 4):
+                        out = c.worker_cycle(
+                            "race-wire", f"mw{i}", pool_size=flavors,
+                            complete=complete)
+                        complete = None
+                        t = out["trial"]
+                        if t is None:
+                            if out["counts"]["completed"] >= budget:
+                                return
+                            continue
+                        t.attach_results([{
+                            "name": "objective", "type": "objective",
+                            "value": (t.params["x"] - 1) ** 2,
+                        }])
+                        t.transition("completed")
+                        complete = {"trial": t.to_dict(),
+                                    "expected_status": "reserved",
+                                    "expected_worker": f"mw{i}"}
+                except BaseException as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        name=f"race-wire-{i}")
+                       for i in range(flavors)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120.0)
+            if errors:
+                raise errors[0]
 
 
 def suite_algo(scale: int = 1) -> None:

@@ -298,6 +298,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="speculative pools hosted algorithms keep banked "
                           "so produce legs answer from memory (default 1 = "
                           "refill-when-stale)")
+    srv.add_argument("--uds", dest="uds_path", default=None, metavar="PATH",
+                     help="also listen on a Unix domain socket at PATH — "
+                          "the same-host fast path; the ping reply "
+                          "advertises it and pod-local clients prefer it "
+                          "over TCP automatically")
     srv.add_argument("--shards", type=int, default=None, metavar="N",
                      help="sharded serving: run N coordinator shard "
                           "subprocesses (consistent-hash ownership by "
@@ -1667,6 +1672,10 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
     shards = (args.shards if args.shards is not None
               else coord_cfg_early.get("shards"))
     if shards:
+        if getattr(args, "uds_path", None):
+            print("--uds applies to single-process serving; sharded "
+                  "deployments route by TCP shard map", file=sys.stderr)
+            return 2
         return _serve_sharded(args, coord_cfg_early, int(shards))
     # CLI flags > config file (`ledger:`/`coordinator:` sections) > defaults
     inner = None
@@ -1695,6 +1704,7 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
             args.suggest_prefetch_depth
             if args.suggest_prefetch_depth is not None
             else coord_cfg.get("suggest_prefetch_depth", 1)),
+        uds_path=args.uds_path or coord_cfg.get("uds_path"),
     )
     serve_forever(server)
     return 0

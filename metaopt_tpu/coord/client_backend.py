@@ -37,7 +37,17 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from metaopt_tpu.coord.protocol import ProtocolError, recv_msg, send_msg
+from metaopt_tpu.coord.protocol import (
+    HAVE_WIRE_V2,
+    ProtocolError,
+    decode_payload,
+    encode_msg,
+    encode_request_v2,
+    recv_msg,
+    recv_payload,
+    send_msg,
+    send_payload,
+)
 from metaopt_tpu.coord.shards import (
     SHARD_MAP_CAP,
     RoutingTable,
@@ -97,6 +107,7 @@ class CoordLedgerClient(LedgerBackend):
         port: Optional[int] = None,
         connect_timeout_s: float = 10.0,
         reconnect_window_s: Optional[float] = None,
+        wire: str = "auto",
         **_: Any,
     ) -> None:
         self.host = host or os.environ.get("METAOPT_TPU_COORD_HOST", "127.0.0.1")
@@ -113,6 +124,13 @@ class CoordLedgerClient(LedgerBackend):
                 os.environ.get("METAOPT_TPU_COORD_RETRY_S", "0") or 0
             )
         self.reconnect_window_s = float(reconnect_window_s)
+        if wire not in ("auto", "v1"):
+            raise ValueError(f"wire must be 'auto' or 'v1', got {wire!r}")
+        #: ``"auto"`` = negotiate wire v2 per address via ping caps;
+        #: ``"v1"`` = force JSON everywhere (debugging, benchmarking the
+        #: codecs against each other). Without msgpack there is nothing to
+        #: negotiate, so auto collapses to v1.
+        self.wire = wire if HAVE_WIRE_V2 else "v1"
         self._local = threading.local()
         #: optional-op capabilities advertised by the server's ping reply;
         #: None until the first probe. A modern server lists them up front
@@ -153,6 +171,28 @@ class CoordLedgerClient(LedgerBackend):
         #: of a crash-aged one.
         self._live: Dict[Tuple[str, str], str] = {}
         self._live_lock = threading.Lock()
+        #: per-address negotiated wire ("v1"/"v2"), learned from that
+        #: address's own ping reply (under ``_caps_lock``). Unknown
+        #: addresses start on v1 — JSON is the lingua franca both
+        #: directions of a rolling upgrade understand.
+        self._addr_wire: Dict[Tuple[str, int], str] = {}
+        #: consecutive v2 exchanges to an address where the SEND succeeded
+        #: but the reply never came — the signature of a middlebox (an old
+        #: JSON-only router relaying to a new shard) choking on binary.
+        #: Three strikes force the address back to v1 for this client's
+        #: lifetime; a clean v2 reply resets the count.
+        self._v2_strikes: Dict[Tuple[str, int], int] = {}
+        self._wire_blocked: set = set()
+        #: same-host fast path: TCP address → the server-advertised Unix
+        #: socket path, recorded only when the path exists locally (a ping
+        #: relayed from another host advertises a path we can't reach).
+        self._uds_paths: Dict[Tuple[str, int], str] = {}
+        #: wire-level byte counters (payload + 4-byte length header per
+        #: frame, both directions) — the coord_wire_bytes_per_trial
+        #: benchmark row reads these.
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self._io_lock = threading.Lock()
 
     # -- connection management --------------------------------------------
     @property
@@ -172,11 +212,30 @@ class CoordLedgerClient(LedgerBackend):
         s = socks[1].get(addr)
         if s is not None:
             return s
-        s = socket.create_connection(addr, timeout=self.connect_timeout_s)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if addr[0] == "unix":
+            # same-host fast path: ("unix", path) from _fast_addr
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(self.connect_timeout_s)
+            try:
+                s.connect(addr[1])
+            except OSError:
+                s.close()
+                raise
+        else:
+            s = socket.create_connection(addr, timeout=self.connect_timeout_s)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.settimeout(None)
         socks[1][addr] = s
         return s
+
+    def _fast_addr(self, addr: Tuple[str, int]):
+        """The address to actually dial: the server-advertised same-host
+        Unix socket when one is known to exist, else ``addr`` itself. The
+        logical TCP address stays the key for wire/caps/incarnation state
+        either way — the UDS is a different door into the same server."""
+        with self._caps_lock:
+            path = self._uds_paths.get(addr)
+        return ("unix", path) if path else addr
 
     def _drop_sock(self, addr: Optional[Tuple[str, int]] = None) -> None:
         addr = addr or self._seed
@@ -202,6 +261,50 @@ class CoordLedgerClient(LedgerBackend):
             return self._seed
         return addrs.get(ring.owner(exp), self._seed)
 
+    def _wire_for(self, addr: Tuple[str, int]) -> str:
+        """The codec to speak to ``addr``: v2 only when that address's own
+        ping advertised it (and it isn't strike-blocked)."""
+        if self.wire != "auto":
+            return "v1"
+        with self._caps_lock:
+            return self._addr_wire.get(addr, "v1")
+
+    def _negotiate(self, s: socket.socket, addr: Tuple[str, int]) -> None:
+        """One v1-JSON ping on this socket to learn the peer's wire (and
+        UDS path). Runs once per previously-unseen address — the seed
+        negotiates through the normal ping flow, this covers direct-to-
+        shard connections that would otherwise never get pinged."""
+        send_msg(s, {"op": "ping", "args": {}, "req": uuid.uuid4().hex})
+        reply = recv_msg(s)
+        if reply is None:
+            raise ConnectionError("coordinator closed during negotiation")
+        if reply.get("ok"):
+            # transport facts only: negotiation must not rewrite op caps
+            # or routing (those belong to the explicit ping flow — a
+            # pinned-caps client stays pinned)
+            with self._caps_lock:
+                self._absorb_transport(addr, reply["result"])
+        else:
+            with self._caps_lock:
+                self._addr_wire.setdefault(addr, "v1")
+
+    def _wire_strike(self, addr: Tuple[str, int]) -> None:
+        """A v2 frame was sent but no reply came back. One old JSON-only
+        hop between us and the v2-capable endpoint (a router mid-rolling-
+        upgrade relaying to a new shard) produces exactly this signature
+        on every attempt — after three in a row, stop speaking v2 to this
+        address instead of looping binary-send/connection-drop forever."""
+        with self._caps_lock:
+            n = self._v2_strikes.get(addr, 0) + 1
+            self._v2_strikes[addr] = n
+            if n >= 3 and addr not in self._wire_blocked:
+                self._wire_blocked.add(addr)
+                self._addr_wire[addr] = "v1"
+                log.warning(
+                    "wire v2 to %s:%s failed %d times in a row with no "
+                    "reply; forcing JSON for this address (old relay in "
+                    "the path?)", addr[0], addr[1], n)
+
     def _exchange(self, msg: Dict[str, Any],
                   addr: Tuple[str, int]) -> Dict[str, Any]:
         """Send one message to ``addr`` with the reconnect-retry loop; the
@@ -211,16 +314,54 @@ class CoordLedgerClient(LedgerBackend):
         attempt = 0
         delay = 0.0
         while True:
+            real = self._fast_addr(addr)
+            wire = "v1"
+            sent_ok = False
             try:
-                s = self._sock(addr)
-                send_msg(s, msg)
-                reply = recv_msg(s)
-                if reply is None:
+                s = self._sock(real)
+                if self.wire == "auto" and msg.get("op") != "ping":
+                    with self._caps_lock:
+                        known = addr in self._addr_wire
+                    if not known:
+                        self._negotiate(s, addr)
+                wire = self._wire_for(addr)
+                payload = None
+                if wire == "v2":
+                    try:
+                        key = experiment_of(msg.get("op"),
+                                            msg.get("args") or {})
+                        payload = encode_request_v2(msg, key or "")
+                    except ProtocolError:
+                        # this one message msgpack can't carry (e.g. an
+                        # int beyond 64 bits): fall back to JSON for the
+                        # frame; the server replies in kind
+                        wire = "v1"
+                if payload is None:
+                    payload = encode_msg(msg)
+                send_payload(s, payload)
+                sent_ok = True
+                raw = recv_payload(s)
+                if raw is None:
                     raise ConnectionError("coordinator closed the connection")
+                with self._io_lock:
+                    self.bytes_sent += len(payload) + 4
+                    self.bytes_recv += len(raw) + 4
+                reply = decode_payload(raw)
+                if wire == "v2":
+                    with self._caps_lock:
+                        self._v2_strikes.pop(addr, None)
                 break
             except (ConnectionError, BrokenPipeError, OSError,
                     ProtocolError) as err:  # incl. a frame cut by shutdown
-                self._drop_sock(addr)
+                self._drop_sock(real)
+                if real != addr:
+                    # the UDS door failed — stop preferring it; the
+                    # immediate retry dials TCP (the path may be stale
+                    # after a server restart, while TCP srv is fine)
+                    with self._caps_lock:
+                        self._uds_paths.pop(addr, None)
+                if wire == "v2" and sent_ok:
+                    self._wire_strike(addr)
                 attempt += 1
                 if attempt >= 2:
                     if time.monotonic() >= deadline:
@@ -296,6 +437,24 @@ class CoordLedgerClient(LedgerBackend):
         exc = _ERRORS.get(reply["error"], CoordRPCError)
         raise exc(reply["msg"])
 
+    def _absorb_transport(self, addr: Tuple[str, int],
+                          r: Dict[str, Any]) -> None:
+        """Record the per-address transport facts from a ping reply: the
+        wire codec and the UDS door. Caller holds ``_caps_lock``. These
+        apply per address (each shard speaks for itself), unlike caps /
+        routing which stay seed-only and ping-flow-only."""
+        caps = tuple(r.get("caps") or ())
+        if ("wire_v2" in caps and HAVE_WIRE_V2
+                and addr not in self._wire_blocked):
+            self._addr_wire[addr] = "v2"
+        else:
+            self._addr_wire[addr] = "v1"
+        path = r.get("uds_path")
+        if path and os.path.exists(path):
+            self._uds_paths[addr] = path
+        else:
+            self._uds_paths.pop(addr, None)
+
     def _absorb_ping(self, addr: Tuple[str, int], r: Dict[str, Any]) -> None:
         """Record what a ping of ``addr`` taught us. Only the seed's reply
         rewrites caps + shard map (a shard's own ping also carries them,
@@ -303,6 +462,7 @@ class CoordLedgerClient(LedgerBackend):
         with self._caps_lock:
             if r.get("incarnation"):
                 self._incarnations[addr] = r["incarnation"]
+            self._absorb_transport(addr, r)
             if addr != self._seed:
                 return
             self._caps = tuple(r.get("caps") or ())

@@ -60,13 +60,18 @@ import json
 import logging
 import os
 import socket
+import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from metaopt_tpu.coord.protocol import (
+    HAVE_WIRE_V2,
     ProtocolError,
+    encode_msg,
+    encode_request_v2,
     recv_msg,
     send_msg,
+    send_payload,
 )
 from metaopt_tpu.coord.wal import read_records
 
@@ -74,9 +79,22 @@ log = logging.getLogger(__name__)
 
 Addr = Tuple[str, int]
 
+#: per-address negotiated wire for the admin plane; learned by a v1 ping
+#: on each fresh connection's first use of an address, forgotten on any
+#: failed call so a rolled-back (JSON-only) peer gets re-probed. The
+#: binary wire matters here because the ship leg of a migration carries
+#: the whole captured experiment state in one ``handoff_apply`` frame.
+_ADDR_WIRE: Dict[Addr, str] = {}
+_ADDR_WIRE_LOCK = threading.Lock()
+
 
 class HandoffError(RuntimeError):
     """A migration step failed past its retry window."""
+
+
+def _forget_wire(addr: Addr) -> None:
+    with _ADDR_WIRE_LOCK:
+        _ADDR_WIRE.pop(addr, None)
 
 
 def _rpc(addr: Addr, op: str, args: Dict[str, Any],
@@ -85,7 +103,30 @@ def _rpc(addr: Addr, op: str, args: Dict[str, Any],
     with socket.create_connection(addr, timeout=timeout_s) as s:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.settimeout(timeout_s)
-        send_msg(s, {"op": op, "args": args})
+        with _ADDR_WIRE_LOCK:
+            wire = _ADDR_WIRE.get(addr)
+        if wire is None:
+            # first contact: one v1-JSON ping learns whether this peer
+            # speaks the binary wire — JSON is what every build answers
+            send_msg(s, {"op": "ping", "args": {}})
+            pong = recv_msg(s)
+            caps = ((pong.get("result") or {}).get("caps") or ()
+                    if pong and pong.get("ok") else ())
+            wire = "v2" if (HAVE_WIRE_V2 and "wire_v2" in caps) else "v1"
+            with _ADDR_WIRE_LOCK:
+                _ADDR_WIRE[addr] = wire
+        msg = {"op": op, "args": args}
+        payload = None
+        if wire == "v2":
+            try:
+                exp = args.get("experiment")
+                payload = encode_request_v2(
+                    msg, exp if isinstance(exp, str) else "")
+            except ProtocolError:
+                payload = None  # unencodable: this frame goes JSON
+        if payload is None:
+            payload = encode_msg(msg)
+        send_payload(s, payload)
         reply = recv_msg(s)
     if reply is None:
         raise ConnectionError(f"{op}: connection closed before reply")
@@ -109,6 +150,9 @@ def call_admin(addr: Addr, op: str, args: Dict[str, Any],
             return _rpc(addr, op, args)
         except (ConnectionError, BrokenPipeError, OSError, ProtocolError,
                 json.JSONDecodeError) as e:
+            # re-probe the wire on the retry: the failure may be a peer
+            # that rolled back to a JSON-only build under the same addr
+            _forget_wire(addr)
             if time.monotonic() >= deadline:
                 raise HandoffError(
                     f"{op} to {addr} failed past the "

@@ -76,10 +76,13 @@ from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple, Union
 
 from metaopt_tpu.coord.protocol import (
+    HAVE_WIRE_V2,
     ProtocolError,
+    decode_payload,
     encode_msg,
-    recv_msg,
-    send_msg,
+    encode_reply_v2,
+    payload_is_v2,
+    recv_payload,
     send_payload,
 )
 from metaopt_tpu.coord.shards import (
@@ -103,7 +106,11 @@ log = logging.getLogger(__name__)
 CAPS = ("count", "fetch_completed_since", "worker_cycle",
         # worker_cycle's complete leg accepts {"trials": [...]} — the
         # batched hunt pushes a whole evaluated pool in one cycle
-        "worker_cycle_multi")
+        "worker_cycle_multi") + (
+            # binary wire format v2 (coord/protocol.py): advertised only
+            # when the codec is importable, so a msgpack-less build simply
+            # never negotiates it and every peer stays on JSON
+            ("wire_v2",) if HAVE_WIRE_V2 else ())
 
 
 class _ShardedLedger:
@@ -296,9 +303,17 @@ class CoordServer:
         wal_group_ms: float = 1.0,
         shard_id: Optional[str] = None,
         shard_map: Optional[Dict[str, Any]] = None,
+        uds_path: Optional[str] = None,
     ) -> None:
         self.inner = inner if inner is not None else MemoryLedger()
         self._bind = (host, port)
+        #: same-host fast path: also listen on this Unix domain socket and
+        #: advertise it in the ping reply — pod-local clients that can
+        #: reach the path switch to it automatically (loopback TCP pays
+        #: per-segment protocol work UDS doesn't). The TCP listener stays;
+        #: UDS is an additional door into the same dispatch.
+        self.uds_path = uds_path
+        self._uds_sock: Optional[socket.socket] = None
         self.snapshot_path = snapshot_path
         self.snapshot_interval_s = snapshot_interval_s
         self.stale_timeout_s = stale_timeout_s
@@ -706,6 +721,17 @@ class CoordServer:
         self._sock.bind(self._bind)
         self._sock.listen(128)
         self._spawn(self._accept_loop, "coord-accept")
+        if self.uds_path:
+            uds = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                os.unlink(self.uds_path)  # stale socket from a dead server
+            except OSError:
+                pass
+            uds.bind(self.uds_path)
+            uds.listen(128)
+            self._uds_sock = uds
+            self._spawn(lambda: self._accept_loop(uds), "coord-accept-uds")
+            log.info("coordinator also listening on uds://%s", self.uds_path)
         if self.stale_timeout_s is not None or self.snapshot_path:
             self._spawn(self._housekeeping_loop, "coord-sweep")
         log.info("coordinator listening on %s:%d", *self.address)
@@ -722,6 +748,21 @@ class CoordServer:
         their reconnect/retry path, where the successor server answers.
         """
         self._stopping.set()
+        if self._uds_sock is not None:
+            # same shutdown-before-close doctrine as the TCP listener
+            try:
+                self._uds_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._uds_sock.close()
+            except OSError:
+                pass
+            self._uds_sock = None
+            try:
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
         if self._sock is not None:
             # shutdown() BEFORE close(): closing an fd another thread is
             # blocked in accept() on does NOT wake that thread on Linux —
@@ -913,11 +954,12 @@ class CoordServer:
             log.exception("event log write failed")
 
     # -- request dispatch --------------------------------------------------
-    def _accept_loop(self) -> None:
-        assert self._sock is not None
+    def _accept_loop(self, sock: Optional[socket.socket] = None) -> None:
+        sock = sock if sock is not None else self._sock
+        assert sock is not None
         while not self._stopping.is_set():
             try:
-                conn, _addr = self._sock.accept()
+                conn, _addr = sock.accept()
             except OSError:
                 return  # socket closed by stop()
             t = threading.Thread(
@@ -941,7 +983,10 @@ class CoordServer:
         pipelined: the next request decodes and executes while this
         reply's batch fsyncs, which is exactly what lets one fsync absorb
         a whole burst of concurrent mutations."""
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # AF_UNIX connections have no Nagle to disable
         self._conns.add(conn)
         outbox: "queue.Queue" = queue.Queue(maxsize=256)
         dead = threading.Event()
@@ -951,7 +996,7 @@ class CoordServer:
                 item = outbox.get()
                 if item is None:
                     return
-                reply, barrier = item
+                reply, barrier, wire = item
                 if dead.is_set():
                     continue  # drain: never block the recv loop on a dead peer
                 if barrier:
@@ -965,9 +1010,11 @@ class CoordServer:
                         os.kill(os.getpid(), _signal_mod.SIGKILL)
                 try:
                     if isinstance(reply, (bytes, bytearray)):
+                        # preserialized in the REQUEST's wire already
+                        # (the enc-cache is wire-keyed): zero re-encoding
                         send_payload(conn, reply)
                     else:
-                        send_msg(conn, reply)
+                        send_payload(conn, self._encode_reply(reply, wire))
                 except (ConnectionError, BrokenPipeError, OSError,
                         ProtocolError):
                     dead.set()
@@ -978,16 +1025,24 @@ class CoordServer:
         try:
             while not self._stopping.is_set() and not dead.is_set():
                 try:
-                    msg = recv_msg(conn)
-                except (ProtocolError, ConnectionError, OSError,
-                        json.JSONDecodeError):
-                    return
-                if msg is None or self._stopping.is_set():
+                    payload = recv_payload(conn)
+                except (ProtocolError, ConnectionError, OSError):
+                    return  # TornFrameError included: drop, client retries
+                if payload is None or self._stopping.is_set():
                     return  # drop, don't ack: stop() snapshots after this
-                reply = self._handle(msg)
+                # per-frame codec detection: the reply always goes back in
+                # the codec the request arrived in, so one connection may
+                # mix v1/v2 freely (rolling upgrades, probe pings)
+                wire = "v2" if payload_is_v2(payload) else "v1"
+                try:
+                    msg = decode_payload(payload)
+                except (ProtocolError, json.JSONDecodeError,
+                        UnicodeDecodeError):
+                    return  # undecodable frame: the stream is unsynced
+                reply = self._handle(msg, wire)
                 # barrier read AFTER dispatch: covers every record the op
                 # appended (possibly more — that only widens the batch)
-                outbox.put((reply, self._barrier_seq(msg.get("op"))))
+                outbox.put((reply, self._barrier_seq(msg.get("op")), wire))
         finally:
             outbox.put(None)
             self._conns.discard(conn)
@@ -1509,7 +1564,19 @@ class CoordServer:
             "map_version": map_version(self.shard_map),
         }}
 
-    def _handle(self, msg: Dict[str, Any]) -> Union[Dict[str, Any], bytes]:
+    @staticmethod
+    def _encode_reply(reply: Dict[str, Any], wire: str) -> bytes:
+        """Reply payload bytes in ``wire``; a reply the binary codec cannot
+        carry falls back to JSON for that frame (receivers auto-detect)."""
+        if wire == "v2":
+            try:
+                return encode_reply_v2(reply)
+            except ProtocolError:
+                pass
+        return encode_msg(reply)
+
+    def _handle(self, msg: Dict[str, Any],
+                wire: str = "v1") -> Union[Dict[str, Any], bytes]:
         """Dispatch one request; returns a reply dict or preencoded bytes.
 
         Mutating ops hold their EXPERIMENT's lock across reply-cache
@@ -1566,9 +1633,9 @@ class CoordServer:
                     self._exp_inflight[exp] = (
                         self._exp_inflight.get(exp, 0) + 1)
         if exp is None:
-            return self._handle_body(op, msg)
+            return self._handle_body(op, msg, wire)
         try:
-            return self._handle_body(op, msg)
+            return self._handle_body(op, msg, wire)
         finally:
             with self._map_cv:
                 n = self._exp_inflight.get(exp, 0) - 1
@@ -1579,8 +1646,8 @@ class CoordServer:
                 if self._migrating:
                     self._map_cv.notify_all()
 
-    def _handle_body(self, op: Optional[str],
-                     msg: Dict[str, Any]) -> Union[Dict[str, Any], bytes]:
+    def _handle_body(self, op: Optional[str], msg: Dict[str, Any],
+                     wire: str = "v1") -> Union[Dict[str, Any], bytes]:
         if op in ("produce", "judge", "should_suspend"):
             # dispatched outside every ledger lock: an algorithm fit (TPE
             # at 10k observations takes seconds) must not stall heartbeats
@@ -1641,7 +1708,10 @@ class CoordServer:
             # miss, never serves stale bytes
             exp = a.get("experiment")
             mut = self._mut.get(exp, 0)
-            key = (op, exp, json.dumps(a, sort_keys=True, default=str))
+            # wire-keyed: a JSON observer and a binary observer at the
+            # same cursor each get bytes preserialized ONCE in their own
+            # codec, and the sender writes them with zero re-encoding
+            key = (op, exp, wire, json.dumps(a, sort_keys=True, default=str))
             with self._enc_lock:
                 ent = self._enc_cache.get(key)
                 if ent is not None and ent[0] == mut:
@@ -1649,8 +1719,8 @@ class CoordServer:
                     self._enc_hits += 1
                     return ent[1]
             try:
-                payload = encode_msg(
-                    {"ok": True, "result": self._dispatch(op, a)})
+                payload = self._encode_reply(
+                    {"ok": True, "result": self._dispatch(op, a)}, wire)
             except Exception as e:  # errors are not worth caching
                 return {"ok": False, "error": type(e).__name__, "msg": str(e)}
             with self._enc_lock:
@@ -1712,6 +1782,11 @@ class CoordServer:
             reply = {"pong": True, "ops": self._ops, "caps": list(CAPS),
                      "incarnation": self._incarnation,
                      "durable": self._wal is not None}
+            if self.uds_path and self._uds_sock is not None:
+                # same-host fast path: clients that can reach this socket
+                # path locally switch their connections to it (old clients
+                # ignore the field — wire framing is unchanged)
+                reply["uds_path"] = self.uds_path
             if self._ring is not None:
                 # sharded serving: teach the client the map so its next
                 # call routes straight to the owning shard; read under

@@ -53,9 +53,15 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from metaopt_tpu.coord.protocol import (
     ProtocolError,
+    WIRE_OPCODES,
+    decode_payload,
     encode_msg,
-    recv_msg,
+    encode_reply_v2,
+    payload_is_v2,
     recv_payload,
+    reply_shard_miss,
+    request_opcode,
+    request_routing_key,
     send_msg,
     send_payload,
 )
@@ -71,6 +77,11 @@ DEFAULT_VNODES = 64
 #: clients that know it read ``shard_map`` off the ping reply and route
 #: directly, clients that don't simply keep using the address they have
 SHARD_MAP_CAP = "shard_map"
+
+#: the ops the router answers itself rather than relaying; a v2 request
+#: whose header opcode is outside this set is routed WITHOUT decoding
+_PAN_SHARD_OPS = ("ping", "list_experiments", "snapshot")
+_PAN_SHARD_OPCODES = frozenset(WIRE_OPCODES[op] for op in _PAN_SHARD_OPS)
 
 
 def stable_hash(key: str) -> int:
@@ -358,7 +369,7 @@ class ShardRouter:
         advertises (post-commit, the migration source/survivors all carry
         the bumped map)."""
         try:
-            reply = json.loads(self._forward(
+            reply = decode_payload(self._forward(
                 sid, encode_msg({"op": "ping", "args": {}}), upstream))
             smap = (reply.get("result") or {}).get("shard_map") \
                 if reply.get("ok") else None
@@ -457,7 +468,7 @@ class ShardRouter:
                     # atomic rename
                     a["path"] = f"{a['path']}.{sid}"
                 try:
-                    r = json.loads(self._forward(
+                    r = decode_payload(self._forward(
                         sid, encode_msg({**msg, "args": a}), upstream))
                 except KeyError:
                     # the sid left the map mid-fan-out (failover shrank
@@ -479,7 +490,7 @@ class ShardRouter:
                     upstream: Dict[str, socket.socket]) -> Dict[str, Any]:
         with self._map_lock:
             first_sid = self._first_sid
-        reply = json.loads(self._forward(
+        reply = decode_payload(self._forward(
             first_sid, encode_msg(msg), upstream))
         if reply.get("ok"):
             res = reply["result"]
@@ -496,11 +507,17 @@ class ShardRouter:
             # the first shard's shard_id is ITS identity, not this
             # connection's — a routed client has no single shard
             res.pop("shard_id", None)
+            # ditto its Unix socket: a same-host client that adopted it
+            # would dial shard 0 directly for SEED traffic and bypass
+            # the router's fan-out ops entirely
+            res.pop("uds_path", None)
         return reply
 
-    def _relay(self, conn: socket.socket, msg: Dict[str, Any],
+    def _relay(self, conn: socket.socket, payload: bytes,
+               exp: Optional[str],
                upstream: Dict[str, socket.socket]) -> None:
-        """Forward one experiment-keyed request, chasing a live hand-off.
+        """Forward one experiment-keyed request payload verbatim (either
+        codec), chasing a live hand-off.
 
         ``Migrating`` means the owner is quiescing the experiment (retry
         the same shard until the commit lands); ``WrongShardError`` means
@@ -509,8 +526,6 @@ class ShardRouter:
         """
         from metaopt_tpu.coord.client_backend import decorrelated_jitter
 
-        exp = experiment_of(msg.get("op"), msg.get("args") or {})
-        payload = encode_msg(msg)
         deadline = time.monotonic() + self.reconnect_window_s
         delay = 0.0
         while True:
@@ -528,19 +543,38 @@ class ShardRouter:
                 delay = decorrelated_jitter(delay)
                 time.sleep(delay)
                 continue
-            # cheap sniff before a JSON parse: routing misses are tiny
-            # error frames, hot replies pass through untouched
-            if (exp is not None and len(raw) < 512
-                    and (b"WrongShardError" in raw or b"Migrating" in raw)):
-                reply = json.loads(raw)
-                if self._routing_miss(reply) \
-                        and time.monotonic() < deadline:
+            if exp is not None:
+                if payload_is_v2(raw):
+                    # two header bytes say miss-or-not — no body decode
+                    miss = reply_shard_miss(raw)
+                else:
+                    # cheap sniff before a JSON parse: routing misses are
+                    # tiny error frames, hot replies pass untouched
+                    miss = None
+                    if (len(raw) < 512 and (b"WrongShardError" in raw
+                                            or b"Migrating" in raw)):
+                        reply = json.loads(raw)
+                        if self._routing_miss(reply):
+                            miss = reply["error"]
+                if miss is not None and time.monotonic() < deadline:
                     self._refresh_map(sid, upstream)
                     delay = decorrelated_jitter(delay)
                     time.sleep(delay)
                     continue
             send_payload(conn, raw)
             return
+
+    @staticmethod
+    def _send_reply(conn: socket.socket, reply: Dict[str, Any],
+                    wire: str) -> None:
+        """A router-composed reply, in the codec the request arrived in."""
+        if wire == "v2":
+            try:
+                send_payload(conn, encode_reply_v2(reply))
+                return
+            except ProtocolError:
+                pass  # unencodable body: this one frame goes JSON
+        send_msg(conn, reply)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -550,16 +584,40 @@ class ShardRouter:
         try:
             while not self._stopping.is_set():
                 try:
-                    msg = recv_msg(conn)
-                except (ProtocolError, ConnectionError, OSError,
-                        json.JSONDecodeError):
+                    payload = recv_payload(conn)
+                except (ProtocolError, ConnectionError, OSError):
                     return
-                if msg is None or self._stopping.is_set():
+                if payload is None or self._stopping.is_set():
+                    return
+                v2 = payload_is_v2(payload)
+                if v2 and request_opcode(payload) not in _PAN_SHARD_OPCODES:
+                    # the zero-parse hot path: a v2 request's routing key
+                    # sits at a fixed header offset, so the router picks
+                    # the shard and forwards the frame verbatim without
+                    # ever decoding the body. (A foreign v2 encoder that
+                    # sets opcode 0 on a pan-shard op degrades to a relay
+                    # to the owning/first shard — still a correct answer,
+                    # minus the router's map augmentation.)
+                    try:
+                        exp = request_routing_key(payload)
+                        self._relay(conn, payload, exp, upstream)
+                    except (ConnectionError, BrokenPipeError, OSError,
+                            ProtocolError, KeyError):
+                        return
+                    continue
+                # pan-shard v2 ops and every JSON frame: decode for
+                # op/args (JSON routing needs the body; pan-shard replies
+                # are composed here)
+                try:
+                    msg = decode_payload(payload)
+                except (ProtocolError, json.JSONDecodeError):
                     return
                 op = msg.get("op")
+                wire = "v2" if v2 else "v1"
                 try:
                     if op == "ping":
-                        send_msg(conn, self._ping_reply(msg, upstream))
+                        self._send_reply(conn, self._ping_reply(
+                            msg, upstream), wire)
                         continue
                     if op == "list_experiments":
                         replies = self._fanout(msg, upstream)
@@ -568,24 +626,26 @@ class ShardRouter:
                         if bad is None:
                             names = sorted(
                                 {n for r in replies for n in r["result"]})
-                            send_msg(conn, {"ok": True, "result": names})
+                            self._send_reply(
+                                conn, {"ok": True, "result": names}, wire)
                         else:
-                            send_msg(conn, bad)
+                            self._send_reply(conn, bad, wire)
                         continue
                     if op == "snapshot":
                         replies = self._fanout(msg, upstream)
                         bad = next(
                             (r for r in replies if not r.get("ok")), None)
                         if bad is None:
-                            send_msg(conn, {
+                            self._send_reply(conn, {
                                 "ok": True,
                                 "result": ";".join(
                                     str(r["result"]) for r in replies),
-                            })
+                            }, wire)
                         else:
-                            send_msg(conn, bad)
+                            self._send_reply(conn, bad, wire)
                         continue
-                    self._relay(conn, msg, upstream)
+                    exp = experiment_of(op, msg.get("args") or {})
+                    self._relay(conn, payload, exp, upstream)
                 except (ConnectionError, BrokenPipeError, OSError,
                         ProtocolError, KeyError):
                     # upstream stayed dead past the window, or the client

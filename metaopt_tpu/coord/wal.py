@@ -8,13 +8,24 @@ broke across restarts. The WAL closes that hole: every acknowledged mutation
 is on disk before its reply leaves the sender thread, and recovery is
 ``restore(snapshot) + replay(WAL tail)``.
 
-Record format (one line per record)::
+Record formats (a log may mix them freely; each record declares its own)::
 
-    {crc32:08x} {compact JSON}\\n
+    v1:  {crc32:08x} {compact JSON}\\n
+    v2:  "W2" {crc32 u32 BE} {len u32 BE} {binary body}
 
-The crc covers the JSON payload bytes; a torn tail (partial last batch after
-a kill -9 or power cut) fails the crc or the JSON parse and
-:func:`read_records` physically truncates the file at the first bad line —
+v1 is the original JSON-line record; v2 frames the wire format's binary
+body (:func:`metaopt_tpu.coord.protocol.encode_body`) with the crc32 over
+the binary bytes. The two are unambiguous at any record boundary: a v1
+line starts with 8 lowercase-hex characters and a space, a v2 record with
+the two magic bytes ``W2`` followed by a binary header — so
+:func:`read_records` dispatches per record and a pre-existing v1 log keeps
+appending v2 records in place (replay of the mixed tail is exercised
+bit-for-bit by the codec property tests). A record the binary codec cannot
+carry falls back to a v1 line, so a "binary" log is always recoverable.
+
+The crc covers the record body bytes; a torn tail (partial last batch
+after a kill -9 or power cut) fails the crc or the parse and
+:func:`read_records` physically truncates the file at the first bad record —
 everything before it was group-commit fsynced and is intact by construction.
 Each record carries a monotonic ``seq``; a snapshot embeds the highest
 ``seq`` it reflects (``wal_seq``), so replay applies only the tail and the
@@ -46,26 +57,55 @@ import json
 import logging
 import os
 import signal
+import struct
 import threading
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+from metaopt_tpu.coord.protocol import (HAVE_WIRE_V2, ProtocolError,
+                                        decode_body, encode_body)
+
 log = logging.getLogger(__name__)
 
+_V2_MAGIC = b"W2"
+_V2_HDR = struct.Struct(">2sII")  # magic, crc32(body), len(body)
+# a single WAL record beyond this is a corrupt length field, not data —
+# same ceiling as the wire's MAX_MSG_BYTES
+_V2_MAX_BODY = 64 * 1024 * 1024
 
-def _frame(rec: Dict[str, Any]) -> bytes:
+
+def _frame_v1(rec: Dict[str, Any]) -> bytes:
     payload = json.dumps(rec, separators=(",", ":"), default=str).encode()
     return b"%08x %s\n" % (zlib.crc32(payload), payload)
 
 
+def _frame_v2(rec: Dict[str, Any]) -> bytes:
+    try:
+        # no default hook: a record msgpack can't carry natively (>64-bit
+        # ints, stray objects) must take the v1 path wholesale so replay
+        # yields exactly what a pure-v1 log would (json keeps big ints;
+        # a msgpack default=str would silently stringify them)
+        body = encode_body(rec)
+    except ProtocolError:
+        # per-record fallback: the log stays mixed rather than losing
+        # the record or failing the append
+        return _frame_v1(rec)
+    return _V2_HDR.pack(_V2_MAGIC, zlib.crc32(body), len(body)) + body
+
+
+# kept under the original name: tests and tooling frame v1 records with it
+_frame = _frame_v1
+
+
 def read_records(path: str, truncate_torn: bool = True
                  ) -> Tuple[List[Dict[str, Any]], int]:
-    """Parse a WAL file; returns ``(records, torn_bytes)``.
+    """Parse a WAL file (v1 lines and v2 binary records, freely mixed);
+    returns ``(records, torn_bytes)``.
 
-    Stops at the first line whose crc or JSON fails — the torn tail of a
-    crash mid-batch — and (by default) truncates the file there so a later
-    append never interleaves new records with torn garbage. ``torn_bytes``
-    is how many bytes were dropped (0 = clean log).
+    Stops at the first record whose crc or parse fails — the torn tail of
+    a crash mid-batch — and (by default) truncates the file there so a
+    later append never interleaves new records with torn garbage.
+    ``torn_bytes`` is how many bytes were dropped (0 = clean log).
     """
     records: List[Dict[str, Any]] = []
     good_end = 0
@@ -78,15 +118,31 @@ def read_records(path: str, truncate_torn: bool = True
     pos = 0
     size = len(data)
     while pos < size:
-        nl = data.find(b"\n", pos)
-        line = data[pos:nl] if nl != -1 else data[pos:]
-        end = (nl + 1) if nl != -1 else size
         try:
-            crc_hex, payload = line.split(b" ", 1)
-            if int(crc_hex, 16) != zlib.crc32(payload):
-                raise ValueError("crc mismatch")
-            rec = json.loads(payload)
-        except (ValueError, json.JSONDecodeError):
+            if data[pos:pos + 2] == _V2_MAGIC:
+                # v2: fixed header + crc'd binary body (length-delimited,
+                # so a body byte that happens to be 0x0a never splits it)
+                if pos + _V2_HDR.size > size:
+                    raise ValueError("torn v2 header")
+                _, crc, length = _V2_HDR.unpack_from(data, pos)
+                end = pos + _V2_HDR.size + length
+                if length > _V2_MAX_BODY or end > size:
+                    raise ValueError("torn v2 body")
+                body = data[pos + _V2_HDR.size:end]
+                if zlib.crc32(body) != crc:
+                    raise ValueError("v2 crc mismatch")
+                rec = decode_body(body)
+                if not isinstance(rec, dict):
+                    raise ValueError("v2 record is not a dict")
+            else:
+                nl = data.find(b"\n", pos)
+                line = data[pos:nl] if nl != -1 else data[pos:]
+                end = (nl + 1) if nl != -1 else size
+                crc_hex, payload = line.split(b" ", 1)
+                if int(crc_hex, 16) != zlib.crc32(payload):
+                    raise ValueError("crc mismatch")
+                rec = json.loads(payload)
+        except (ValueError, json.JSONDecodeError, ProtocolError):
             torn = size - pos
             break
         records.append(rec)
@@ -147,13 +203,22 @@ class WriteAheadLog:
     ``target_seq`` is fsynced, electing one caller as the batch leader.
     ``fsync=False`` keeps the write ordering but skips the fsync — for
     benchmarks isolating the syscall cost, never for production.
+
+    ``binary`` selects the record framing for NEW records (default: v2
+    binary when the codec is available). Replay always accepts both
+    framings, so flipping it — or upgrading a server over an existing v1
+    log — needs no migration: the log is simply mixed from that point on.
     """
 
     def __init__(self, path: str, fsync: bool = True,
-                 group_window_s: float = 0.0) -> None:
+                 group_window_s: float = 0.0,
+                 binary: Optional[bool] = None) -> None:
         self.path = path
         self.fsync = fsync
         self.group_window_s = group_window_s
+        self.binary = HAVE_WIRE_V2 if binary is None else (
+            bool(binary) and HAVE_WIRE_V2)
+        self._frame_rec = _frame_v2 if self.binary else _frame_v1
         self._buf_lock = threading.Lock()   # buffer + seq counter
         self._cv = threading.Condition()    # group-commit leader election
         self._pending: List[bytes] = []
@@ -229,7 +294,7 @@ class WriteAheadLog:
             seq = self._next_seq
             self._next_seq += 1
             rec["seq"] = seq
-            self._pending.append(_frame(rec))
+            self._pending.append(self._frame_rec(rec))
             self._appended = seq
         return seq
 
@@ -410,8 +475,10 @@ class WriteAheadLog:
             tail = [r for r in records if r.get("seq", 0) > upto_seq]
             tmp = self.path + ".tmp"
             with open(tmp, "wb") as f:
+                # rewritten in the log's own framing: compaction after an
+                # upgrade is what migrates a mixed v1/v2 log to pure v2
                 for r in tail:
-                    f.write(_frame(r))
+                    f.write(self._frame_rec(r))
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)

@@ -766,9 +766,9 @@ class TestWorkerCycle:
                                  if c != "worker_cycle"]
                 return r
 
-            def _handle(self, msg):
+            def _handle(self, msg, wire="v1"):
                 assert msg.get("op") != "worker_cycle"
-                return super()._handle(msg)
+                return super()._handle(msg, wire)
 
         with OldServer() as s:
             c = _client(s)

@@ -131,6 +131,29 @@ def test_unjournaled_op_fires():
     assert d1[0].detail == "register"
 
 
+def test_wire_opcode_drift_fires():
+    fs = check_durability(_mods("bad_wire_opcode_drift.py"),
+                          _fixture_cfg())
+    d4 = {f.detail for f in fs if f.rule == "MTD004"}
+    assert d4 == {"missing|register", "dup|2", "reserved|probe"}
+    # the register branch journals and the op sets agree — only the
+    # opcode table drifted
+    assert _rules(fs) == {"MTD004"}
+
+
+def test_wire_opcodes_from_config_override():
+    """An explicit cfg.wire_opcodes wins over (and here, substitutes
+    for) a parsed table — the fixture without one stays checkable."""
+    cfg = _fixture_cfg()
+    cfg.wire_opcodes = {"register": 7, "purge": 8}
+    fs = check_durability(_mods("bad_unjournaled_op.py"), cfg)
+    assert "MTD004" not in _rules(fs)   # both ops covered, table clean
+    cfg.wire_opcodes = {"ping": 1}
+    fs = check_durability(_mods("bad_unjournaled_op.py"), cfg)
+    d4 = {f.detail for f in fs if f.rule == "MTD004"}
+    assert "missing|register" in d4
+
+
 # -- the clean fixture stays silent everywhere -----------------------------
 @pytest.mark.parametrize("checker", [check_locks, check_jax,
                                      check_durability])
