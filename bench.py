@@ -928,6 +928,24 @@ def main() -> None:
             if n_shards == 1 and shard_base_tps and shard_tps:
                 coord_stats["coord_shard_overhead_pct"] = round(
                     100.0 * (1.0 - shard_tps / shard_base_tps), 1)
+
+        # multi-tenant service plane at the full 1k-experiment fleet
+        # (benchmarks/coord_scale.py run_multitenant): fairness under a
+        # hot tenant, evicted-vs-resident RSS (fresh subprocesses), and
+        # the warm-vs-cold transfer-prior study. Single shot — the
+        # fairness/residency/transfer figures are acceptance bars with
+        # wide margins, not drift-sensitive medians
+        from benchmarks.coord_scale import run_multitenant
+
+        mt_row = run_multitenant(experiments=1000)
+        for mt_key in ("coord_trials_per_s_1k_exp", "coord_fairness_jain_1k",
+                       "coord_evict_rss_mb", "coord_resident_rss_mb",
+                       "coord_evict_rss_ratio", "coord_evictions_1k",
+                       "coord_hydrations_1k", "status_scan_ms_1k",
+                       "transfer_warm_trials_ratio",
+                       "transfer_time_to_good_s", "transfer_cold_time_s"):
+            if mt_row.get(mt_key) is not None:
+                coord_stats[mt_key] = mt_row[mt_key]
     except Exception as err:  # the TPE headline must survive a coord break
         coord_stats["coord_bench_error"] = f"{type(err).__name__}: {err}"
 
@@ -1082,7 +1100,9 @@ def main() -> None:
                 "gp_prefetch_hit_rate",
                 "batch_eval_trials_per_s_pool8",
                 "batch_eval_trials_per_s_pool64",
-                "batch_eval_speedup", "batch_eval_launches_per_pool"):
+                "batch_eval_speedup", "batch_eval_launches_per_pool",
+                "coord_trials_per_s_1k_exp", "coord_fairness_jain_1k",
+                "coord_evict_rss_ratio", "transfer_warm_trials_ratio"):
         if key in result["extra"]:
             compact[key] = result["extra"][key]
     print(json.dumps(compact))

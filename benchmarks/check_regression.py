@@ -83,6 +83,21 @@ BATCH_TPS_METRICS = ("batch_eval_trials_per_s_pool8",
                      "batch_eval_trials_per_s_pool64")
 BATCH_SPEEDUP_METRIC = "batch_eval_speedup"
 BATCH_SPEEDUP_FLOOR = 3.0
+#: multi-tenant service plane (ISSUE 16). The fairness floor ENFORCES the
+#: moment the artifact carries the metric — fairness under a hot tenant is
+#: the tentpole's acceptance bar, not a drift watch, so there is no
+#: informational-until-baselined grace for it. Likewise the residency
+#: ratio (evicted fleet must cost ≥3x less RSS than all-resident) and the
+#: transfer bar (warm start reaches the cold study's best in ≤ half the
+#: trials). The 1k-experiment throughput gates inversely once a committed
+#: baseline carries it, like every other throughput here.
+FAIRNESS_METRIC = "coord_fairness_jain_1k"
+FAIRNESS_FLOOR = 0.9
+EVICT_RSS_METRIC = "coord_evict_rss_ratio"
+EVICT_RSS_FLOOR = 3.0
+TRANSFER_METRIC = "transfer_warm_trials_ratio"
+TRANSFER_CEILING = 0.5
+MT_TPS_METRIC = "coord_trials_per_s_1k_exp"
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -408,6 +423,66 @@ def main() -> int:
             rc = 1
         else:
             print(f"OK {hverdict}")
+
+    # multi-tenant service plane: three absolute acceptance bars that
+    # ENFORCE whenever the artifact carries them (no baseline grace — they
+    # are the tentpole's acceptance criteria, all substrate-independent
+    # host-CPU figures), plus the 1k-experiment throughput which gates
+    # inversely once a committed baseline records it
+    jain = extra.get(FAIRNESS_METRIC)
+    if jain is None:
+        print(f"{FAIRNESS_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif float(jain) < FAIRNESS_FLOOR:
+        print(f"FAIL {FAIRNESS_METRIC}: {float(jain):.3f} < the "
+              f"{FAIRNESS_FLOOR:.1f} fairness floor (hot tenant starved "
+              "the small tenants)")
+        rc = 1
+    else:
+        print(f"OK {FAIRNESS_METRIC}: {float(jain):.3f} "
+              f"(floor {FAIRNESS_FLOOR:.1f})")
+    rss_ratio = extra.get(EVICT_RSS_METRIC)
+    if rss_ratio is None:
+        print(f"{EVICT_RSS_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif float(rss_ratio) < EVICT_RSS_FLOOR:
+        print(f"FAIL {EVICT_RSS_METRIC}: {float(rss_ratio):.2f}x < the "
+              f"{EVICT_RSS_FLOOR:.0f}x residency floor (eviction is not "
+              "reclaiming memory)")
+        rc = 1
+    else:
+        print(f"OK {EVICT_RSS_METRIC}: {float(rss_ratio):.2f}x "
+              f"(floor {EVICT_RSS_FLOOR:.0f}x)")
+    tratio = extra.get(TRANSFER_METRIC)
+    if tratio is None:
+        print(f"{TRANSFER_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif float(tratio) > TRANSFER_CEILING:
+        print(f"FAIL {TRANSFER_METRIC}: {float(tratio):.3f} > the "
+              f"{TRANSFER_CEILING:.1f} ceiling (warm start is not "
+              "halving time-to-good)")
+        rc = 1
+    else:
+        print(f"OK {TRANSFER_METRIC}: {float(tratio):.3f} "
+              f"(ceiling {TRANSFER_CEILING:.1f})")
+    mt_val = extra.get(MT_TPS_METRIC)
+    mt_bases = [b for b in matching if b[3].get(MT_TPS_METRIC)]
+    if mt_val is None or not mt_bases:
+        print(f"{MT_TPS_METRIC}: artifact or committed baseline missing "
+              "the metric — nothing to gate against (pass)")
+    else:
+        mtb_name, _, _, mtb_parsed = mt_bases[-1]
+        mt_base = float(mtb_parsed[MT_TPS_METRIC])
+        mt_ratio = float(mt_val) / mt_base
+        mt_verdict = (f"{MT_TPS_METRIC}: {float(mt_val):.0f} vs "
+                      f"{mt_base:.0f} trials/s ({mtb_name}, "
+                      f"{art['backend']}) → {mt_ratio:.3f}x")
+        if mt_ratio < 1.0 - args.threshold:
+            print(f"FAIL {mt_verdict} — throughput regressed past the "
+                  f"{args.threshold:.0%} threshold")
+            rc = 1
+        else:
+            print(f"OK {mt_verdict}")
     return rc
 
 

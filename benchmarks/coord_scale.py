@@ -501,6 +501,356 @@ def run_handoff(trials: int = 48, seed: int = 0) -> dict:
         shutil.rmtree(snap_dir, True)
 
 
+# subprocess probe for run_multitenant's RSS phase: RSS of a fresh process
+# is only meaningful measured IN a fresh process (the benchmark driver's
+# own heap — jax, prior phases — would swamp the delta). argv:
+#   <repo> build   <dir> <n_exp> <n_trials> <evict 0|1>
+#   <repo> measure <dir> <n_exp> <n_trials> <evict 0|1>
+_RSS_SRC = r"""
+import gc, json, os, sys
+sys.path.insert(0, sys.argv[1])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+role, root = sys.argv[2], sys.argv[3]
+n_exp, n_trials, evict = int(sys.argv[4]), int(sys.argv[5]), sys.argv[6] == "1"
+
+
+def rss_kb():
+    with open("/proc/self/status") as fh:
+        for line in fh:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+from metaopt_tpu.coord import CoordServer
+from metaopt_tpu.ledger import Trial
+
+snap = os.path.join(root, "snap.json")
+evict_dir = os.path.join(root, "evict")
+SPACE = {"lr": "loguniform(1e-5, 1e-1)", "mom": "uniform(0, 1)"}
+if role == "build":
+    server = CoordServer(snapshot_path=snap, evict_dir=evict_dir)
+    server.start()
+    try:
+        for e in range(n_exp):
+            name = "rss-exp%d" % e
+            server.ledger.create_experiment({
+                "name": name, "tenant": "t%d" % (e % 4), "space": SPACE,
+                "algorithm": {"random": {"seed": e}},
+                "max_trials": 10 ** 6, "pool_size": 8,
+            })
+            for i in range(n_trials):
+                server.ledger.register(Trial(
+                    params={"lr": 1e-3 * (1.0 + 1e-6 * i), "mom": 0.5},
+                    experiment=name))
+            if evict and not server.evict_experiment(name):
+                raise RuntimeError("evict refused for %s" % name)
+    finally:
+        server.stop()
+    print(json.dumps({"built": n_exp, "evicted": evict}))
+else:
+    gc.collect()
+    rss0 = rss_kb()
+    server = CoordServer(snapshot_path=snap, evict_dir=evict_dir)
+    server.start()
+    gc.collect()
+    rss1 = rss_kb()
+    try:
+        st = server._tenant_stats({})
+    finally:
+        server.snapshot_path = None  # measurement only: no rewrite
+        server.stop()
+    print(json.dumps({"rss0_kb": rss0, "rss1_kb": rss1,
+                      "resident": st["resident"], "evicted": st["evicted"]}))
+"""
+
+#: warm-vs-cold transfer study space — a plain quadratic bowl; enough
+#: dimensions that 50 cold TPE trials do NOT solve it by accident
+_T_SPACE = {
+    "x0": "uniform(0, 1)",
+    "x1": "uniform(0, 1)",
+    "x2": "uniform(0, 1)",
+    "x3": "uniform(0, 1)",
+}
+_T_CENTER = (0.32, 0.58, 0.41, 0.67)
+
+
+def _transfer_study(led, name, center, budget, seed,
+                    transfer_from=None, stop_at=None):
+    """Run a sequential TPE study on the quadratic bowl; returns
+    ``(best, trials_used, wall_s)``. ``stop_at`` ends the study the
+    moment the best objective reaches it (the warm run's clock)."""
+    from metaopt_tpu.algo.tpe import TPE
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.space import build_space
+    from metaopt_tpu.worker.producer import Producer
+
+    meta = {"transfer_from": transfer_from} if transfer_from else {}
+    exp = Experiment(
+        name, led, space=build_space(_T_SPACE),
+        algorithm={"tpe": {"seed": seed, "n_initial_points": 5}},
+        max_trials=budget + 8, pool_size=1, metadata=meta,
+    ).configure()
+    producer = Producer(exp, TPE(exp.space, seed=seed, n_initial_points=5))
+    best, used = float("inf"), 0
+    t0 = time.perf_counter()
+    for _ in range(budget):
+        producer.produce(1)
+        trial = exp.reserve_trial("mt-transfer")
+        if trial is None:
+            break
+        val = sum((trial.params[f"x{d}"] - center[d]) ** 2
+                  for d in range(len(center)))
+        exp.push_results(trial, [
+            {"type": "objective", "name": "loss", "value": val}])
+        best = min(best, val)
+        used += 1
+        if stop_at is not None and best <= stop_at:
+            break
+    return best, used, time.perf_counter() - t0
+
+
+def run_multitenant(experiments: int = 1000, window_s: float = 5.0,
+                    rss_trials: int = 48, transfer_budget: int = 50,
+                    seed: int = 0) -> dict:
+    """The 1k-experiment multi-tenant service row (ISSUE 16d): fair
+    scheduling + residency + transfer priors, all same-run figures.
+
+    Three phases, one row:
+
+    1. **fairness/throughput** — ``experiments`` experiments registered
+       round-robin over 4 equal-weight tenants against one coordinator
+       with an LRU residency budget; a hot tenant (8 driver threads)
+       competes with 3 small tenants (2 threads each) over a fixed
+       ``worker_cycle`` window. ``coord_fairness_jain_1k`` is Jain's
+       index over per-tenant produce grants per weight unit — without
+       the deficit scheduler the demand imbalance pins it near 0.64;
+       fair sharing holds it ≥0.9. ``coord_trials_per_s_1k_exp`` is the
+       window's completed-trials throughput with the full experiment
+       fleet registered (most of it evicted to its residency budget).
+       ``status_scan_ms_1k`` times the O(1)-per-experiment status-count
+       scan (``tenant_stats(include_experiments=True)``) — the
+       no-hydration satellite's figure.
+    2. **RSS probe** — two build/measure subprocess pairs (fresh
+       interpreters: the delta must not include this driver's heap):
+       the same ``experiments`` x ``rss_trials`` fleet recovered
+       all-resident vs all-evicted; ``coord_evict_rss_ratio`` =
+       resident-delta / evicted-delta, gated ≥3x.
+    3. **transfer warm-start** — cold TPE vs transfer-prior-seeded TPE
+       on a quadratic bowl whose optimum sits 0.02 from the ancestor's;
+       ``transfer_warm_trials_ratio`` = trials the warm study needs to
+       reach the cold study's best-by-``transfer_budget``, over that
+       budget (gate: ≤0.5). ``transfer_time_to_good_s`` is the warm
+       study's wall clock to that bar.
+    """
+    import random
+    import shutil
+    import subprocess
+    import tempfile
+
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+    from metaopt_tpu.coord.tenancy import jain_index
+    from metaopt_tpu.ledger import Experiment, MemoryLedger
+    from metaopt_tpu.space import build_space
+
+    tenants = ["acme", "beta", "gamma", "delta"]
+    row: dict = {"mode": "multitenant", "experiments": experiments}
+
+    # -- phase 1: fairness + throughput at full fleet size ---------------
+    snap_dir = tempfile.mkdtemp(prefix="coordscale-mt-")
+    try:
+        server = CoordServer(
+            snapshot_path=os.path.join(snap_dir, "snap.json"),
+            max_resident=128,
+            tenant_weights={t: 1.0 for t in tenants},
+        )
+        server.start()
+        try:
+            host, port = server.address
+            client = CoordLedgerClient(host=host, port=port)
+            space_cfg = build_space(SPACE).configuration
+            t0 = time.perf_counter()
+            for i in range(experiments):
+                client.create_experiment({
+                    "name": f"mt-exp{i}",
+                    "tenant": tenants[i % len(tenants)],
+                    "space": space_cfg,
+                    "algorithm": {"random": {"seed": seed + i}},
+                    "max_trials": 10 ** 6,
+                    "pool_size": 8,
+                })
+            row["register_fleet_s"] = round(time.perf_counter() - t0, 2)
+
+            # let the residency sweep drain the fleet to its budget BEFORE
+            # the measured window (the evict fsync burst is setup, not
+            # steady-state service)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                st = client.tenant_stats()
+                if st["resident"] <= 128:
+                    break
+                time.sleep(0.25)
+
+            # O(1)-per-experiment status counts, no hydration: the whole
+            # fleet scanned from stubs in one op
+            hyd0 = client.tenant_stats()["hydrations"]
+            t0 = time.perf_counter()
+            scan = client.tenant_stats(include_experiments=True)
+            row["status_scan_ms_1k"] = round(
+                1e3 * (time.perf_counter() - t0), 1)
+            if len(scan.get("experiments", {})) != experiments:
+                raise RuntimeError(
+                    f"status scan saw {len(scan.get('experiments', {}))}"
+                    f"/{experiments} experiments")
+            if client.tenant_stats()["hydrations"] != hyd0:
+                raise RuntimeError("status scan hydrated experiments")
+
+            # hot tenant: 8 drivers; small tenants: 2 each. One experiment
+            # per driver so per-experiment locks never serialize tenants
+            # against each other — contention is purely for produce grants.
+            demand = [8, 2, 2, 2]
+            drivers = []  # (tenant_idx, experiment_name, worker_id)
+            for t_i, n in enumerate(demand):
+                for k in range(n):
+                    drivers.append(
+                        (t_i, f"mt-exp{t_i + len(tenants) * k}",
+                         f"mt-w{t_i}-{k}"))
+            stop = threading.Event()
+            completed = [0] * len(drivers)
+            throttled = [0] * len(drivers)
+
+            def drive(slot, name, wid):
+                done = None
+                while not stop.is_set():
+                    try:
+                        out = client.worker_cycle(
+                            name, wid, pool_size=4, complete=done)
+                    except Exception:
+                        if stop.is_set():
+                            return
+                        raise
+                    done = None
+                    if out.get("throttled"):
+                        throttled[slot] += 1
+                    trial = out.get("trial")
+                    if trial is None:
+                        time.sleep(0.001)
+                        continue
+                    trial.attach_results([{
+                        "type": "objective", "name": "loss",
+                        "value": objective(trial.params)}])
+                    trial.transition("completed")
+                    done = {"trial": trial.to_dict(),
+                            "expected_status": "reserved",
+                            "expected_worker": wid}
+                    completed[slot] += 1
+
+            threads = [
+                threading.Thread(target=drive, args=(s, nm, wid), daemon=True)
+                for s, (_, nm, wid) in enumerate(drivers)
+            ]
+            gc.collect()
+            for t in threads:
+                t.start()
+            time.sleep(1.0)  # warm-up: hydrate actives, fill pools
+            s0 = client.tenant_stats()
+            c0 = sum(completed)
+            t0 = time.perf_counter()
+            time.sleep(window_s)
+            s1 = client.tenant_stats()
+            c1 = sum(completed)
+            wall = time.perf_counter() - t0
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+
+            grants = []
+            for t_i, tenant in enumerate(tenants):
+                g1 = (s1["tenants"].get(tenant) or {}).get("granted", 0)
+                g0 = (s0["tenants"].get(tenant) or {}).get("granted", 0)
+                grants.append(float(g1 - g0))
+            row["coord_trials_per_s_1k_exp"] = round((c1 - c0) / wall, 2)
+            row["coord_fairness_jain_1k"] = round(jain_index(grants), 4)
+            row["tenant_grants_window"] = [int(g) for g in grants]
+            row["throttled_cycles_window"] = int(sum(throttled))
+            row["coord_evictions_1k"] = s1["evictions"]
+            row["coord_hydrations_1k"] = s1["hydrations"]
+            row["resident_after_window"] = s1["resident"]
+        finally:
+            server.snapshot_path = None  # benched state is throwaway
+            server.stop()
+    finally:
+        shutil.rmtree(snap_dir, True)
+
+    # -- phase 2: evicted-vs-resident RSS, fresh subprocesses ------------
+    rss = {}
+    for label, evict in (("resident", "0"), ("evicted", "1")):
+        root = tempfile.mkdtemp(prefix=f"coordscale-mt-rss-{label}-")
+        try:
+            argv_tail = [REPO, "", root, str(experiments),
+                         str(rss_trials), evict]
+            for role in ("build", "measure"):
+                argv_tail[1] = role
+                proc = subprocess.run(
+                    [sys.executable, "-c", _RSS_SRC] + argv_tail,
+                    capture_output=True, text=True, timeout=600)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"rss {label}/{role} failed: {proc.stderr[-2000:]}")
+                out = json.loads(proc.stdout.strip().splitlines()[-1])
+            if label == "evicted" and out["evicted"] != experiments:
+                raise RuntimeError(
+                    f"rss probe: {out['evicted']}/{experiments} evicted")
+            rss[label] = max(1, out["rss1_kb"] - out["rss0_kb"])
+        finally:
+            shutil.rmtree(root, True)
+    row["coord_resident_rss_mb"] = round(rss["resident"] / 1024.0, 1)
+    row["coord_evict_rss_mb"] = round(rss["evicted"] / 1024.0, 1)
+    row["coord_evict_rss_ratio"] = round(rss["resident"] / rss["evicted"], 2)
+
+    # -- phase 3: transfer priors, warm vs cold --------------------------
+    led = MemoryLedger()
+    anc_center = tuple(c + 0.02 for c in _T_CENTER)
+    anc = Experiment(
+        "mt-anc", led, space=build_space(_T_SPACE),
+        algorithm={"random": {"seed": seed}}, max_trials=80, pool_size=1,
+    ).configure()
+    rng = random.Random(seed)
+    for _ in range(64):
+        params = {
+            f"x{d}": min(1.0, max(0.0, anc_center[d] + rng.gauss(0.0, 0.1)))
+            for d in range(len(anc_center))
+        }
+        try:
+            anc.ledger.register(anc.make_trial(params))
+        except Exception:
+            continue  # duplicate sample: 63 ancestors serve as well as 64
+    while True:
+        trial = anc.reserve_trial("mt-anc-w")
+        if trial is None:
+            break
+        val = sum((trial.params[f"x{d}"] - anc_center[d]) ** 2
+                  for d in range(len(anc_center)))
+        anc.push_results(trial, [
+            {"type": "objective", "name": "loss", "value": val}])
+
+    cold_best, cold_used, cold_s = _transfer_study(
+        led, "mt-cold", _T_CENTER, transfer_budget, seed + 1)
+    warm_best, warm_used, warm_s = _transfer_study(
+        led, "mt-warm", _T_CENTER, transfer_budget, seed + 2,
+        transfer_from=["mt-anc"], stop_at=cold_best)
+    row["transfer_cold_best"] = round(cold_best, 6)
+    row["transfer_warm_best"] = round(warm_best, 6)
+    row["transfer_cold_trials"] = cold_used
+    row["transfer_warm_trials"] = warm_used
+    row["transfer_warm_trials_ratio"] = round(
+        warm_used / max(1, cold_used), 3)
+    row["transfer_time_to_good_s"] = round(warm_s, 3)
+    row["transfer_cold_time_s"] = round(cold_s, 3)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workers", nargs="*", type=int, default=[1, 8, 32])
@@ -534,6 +884,16 @@ def main():
         "--handoff", action="store_true",
         help="also time a live experiment hand-off between 2 shards and "
              "a kill-triggered failover redistribution",
+    )
+    ap.add_argument(
+        "--multitenant", action="store_true",
+        help="also run the 1k-experiment multi-tenant service row: "
+             "fairness under a hot tenant, evicted-vs-resident RSS, "
+             "warm-vs-cold transfer priors (all same-run figures)",
+    )
+    ap.add_argument(
+        "--experiments", type=int, default=1000,
+        help="fleet size for --multitenant (default 1000)",
     )
     ap.add_argument("--save", action="store_true")
     args = ap.parse_args()
@@ -680,6 +1040,11 @@ def main():
         rows.append(row)
     if args.handoff:
         row = run_handoff()
+        row.update(provenance())
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    if args.multitenant:
+        row = run_multitenant(experiments=args.experiments)
         row.update(provenance())
         print(json.dumps(row), flush=True)
         rows.append(row)

@@ -194,6 +194,10 @@ class BaseAlgorithm(ABC):
             )
         self.rng = np.random.default_rng(seed)
         self._observed: Dict[str, float] = {}  # trial id -> objective
+        #: how many of the oldest observations were seeded from an
+        #: ancestor experiment (transfer warm-start) rather than measured
+        #: in THIS experiment — algorithms may discount them
+        self._n_prior = 0
 
     # -- core contract ----------------------------------------------------
     @abstractmethod
@@ -213,6 +217,24 @@ class BaseAlgorithm(ABC):
 
     def _observe_one(self, trial: Trial) -> None:  # subclass hook
         pass
+
+    def observe_prior(self, trials: Sequence[Trial]) -> None:
+        """Seed the buffer from an ANCESTOR experiment's completed trials.
+
+        Transfer warm-start (EVC): points enter through the normal
+        ``observe`` path — so every subclass buffer stays consistent —
+        but are counted in ``n_prior`` so acquisition can discount them
+        against locally-measured evidence. Must be called before any
+        real ``observe`` (priors occupy the oldest rows); the Producer
+        enforces that by resolving ``transfer_from`` at warm-start.
+        """
+        before = len(self._observed)
+        self.observe(trials)
+        self._n_prior += len(self._observed) - before
+
+    @property
+    def n_prior(self) -> int:
+        return self._n_prior
 
     #: True when the instance wants the Producer to report in-flight
     #: (reserved) trials each cycle via :meth:`set_pending` — the
@@ -274,10 +296,12 @@ class BaseAlgorithm(ABC):
         return {name: {k: v for k, v in self._config.items()}}
 
     def state_dict(self) -> Dict[str, Any]:
-        return {"observed": dict(self._observed)}
+        return {"observed": dict(self._observed),
+                "n_prior": self._n_prior}
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self._observed = dict(state.get("observed", {}))
+        self._n_prior = int(state.get("n_prior", 0))
 
 
 def _load_plugin(name: str) -> bool:

@@ -473,6 +473,7 @@ class GPBO(SuggestAhead, BaseAlgorithm):
         refit_iters: int = 15,
         drift_threshold: float = 0.25,
         suggest_prefetch_depth: int = 1,
+        transfer_max_prior: int = 32,
         **config: Any,
     ):
         super().__init__(
@@ -489,6 +490,7 @@ class GPBO(SuggestAhead, BaseAlgorithm):
             refit_iters=refit_iters,
             drift_threshold=drift_threshold,
             suggest_prefetch_depth=suggest_prefetch_depth,
+            transfer_max_prior=transfer_max_prior,
             **config,
         )
         self.n_initial_points = n_initial_points
@@ -556,10 +558,34 @@ class GPBO(SuggestAhead, BaseAlgorithm):
         self._ei_active = False
         self._init_suggest_ahead(suggest_prefetch_depth)
 
+        # transfer warm-start: the factor is O(n³) in resident rows, so a
+        # large ancestor history is subsampled to its best points rather
+        # than weight-discounted (the GP has no per-row weight)
+        self.transfer_max_prior = max(0, int(transfer_max_prior))
+
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:
-        self._X.append(self.cube.transform(trial.params))
+        # float32 from the start, same rationale as TPE: serialized state
+        # must round-trip bit-identically (snapshot, evict→hydrate)
+        self._X.append(np.asarray(
+            self.cube.transform(trial.params), np.float32))
         self._y.append(float(trial.objective))
+
+    def observe_prior(self, trials) -> None:
+        """Seed from an ancestor, keeping only its best points.
+
+        TPE discounts prior rows in the mixture weights; a GP's evidence
+        enters through the Gram matrix, where every extra row costs
+        cubic work and there is no per-row weight to discount. Capping
+        the transfer at the ``transfer_max_prior`` best-by-objective
+        ancestors keeps the strongest signal (where the optimum
+        plausibly lives) at bounded factor cost.
+        """
+        ranked = sorted(
+            (t for t in trials if t.objective is not None),
+            key=lambda t: t.objective,
+        )
+        super().observe_prior(ranked[: self.transfer_max_prior])
 
     def observe(self, trials) -> None:
         with self._kernel_lock:

@@ -57,6 +57,7 @@ class TPE(SuggestAhead, BaseAlgorithm):
         pool_prefetch: int = 8,
         parallel_strategy: Optional[str] = None,
         suggest_prefetch_depth: int = 1,
+        transfer_discount: float = 0.25,
         **config: Any,
     ):
         super().__init__(
@@ -71,6 +72,7 @@ class TPE(SuggestAhead, BaseAlgorithm):
             pool_prefetch=pool_prefetch,
             parallel_strategy=parallel_strategy,
             suggest_prefetch_depth=suggest_prefetch_depth,
+            transfer_discount=transfer_discount,
             **config,
         )
         self.n_initial_points = n_initial_points
@@ -80,6 +82,10 @@ class TPE(SuggestAhead, BaseAlgorithm):
         self.full_weight_num = full_weight_num
         self.equal_weight = equal_weight
         self.pool_prefetch = max(1, int(pool_prefetch))
+        #: weight multiplier on transfer-prior rows (observe_prior):
+        #: seeded ancestor evidence shapes the fit but never outvotes
+        #: locally-measured points once those exist
+        self.transfer_discount = float(transfer_discount)
 
         # parallel strategy (the lineage's "liar" mechanism): in-flight
         # trials join the fit with a lie objective so concurrent workers
@@ -158,7 +164,11 @@ class TPE(SuggestAhead, BaseAlgorithm):
 
     # -- observe -----------------------------------------------------------
     def _observe_one(self, trial: Trial) -> None:
-        self._X.append(self.cube.transform(trial.params))
+        # stored float32 from the start: the device buffer is float32
+        # anyway, and state_dict→load_state_dict round-trips (snapshot,
+        # evict→hydrate) must reproduce the serialized form bit-identically
+        self._X.append(np.asarray(
+            self.cube.transform(trial.params), np.float32))
         self._y.append(float(trial.objective))
 
     def observe(self, trials: List[Trial]) -> None:
@@ -242,6 +252,7 @@ class TPE(SuggestAhead, BaseAlgorithm):
                     self.n_initial_points, 0, jax.random.PRNGKey(0),
                     jnp.asarray(n_choices), jnp.asarray(cont),
                     self.gamma, self.prior_weight, self.full_weight_num,
+                    0, 1.0,
                     n_cand=self.n_ei_candidates, n_out=n_out,
                     kmax=self._kmax, equal_weight=self.equal_weight,
                     n_good_pad=g_pad, n_bad_pad=b_pad,
@@ -326,9 +337,16 @@ class TPE(SuggestAhead, BaseAlgorithm):
         scheme); ``equal_weight`` disables the ramp.
         """
         if self.equal_weight or n <= self.full_weight_num:
-            return np.ones(n)
-        ramp = np.linspace(1.0 / n, 1.0, n - self.full_weight_num)
-        return np.concatenate([ramp, np.ones(self.full_weight_num)])
+            w = np.ones(n)
+        else:
+            ramp = np.linspace(1.0 / n, 1.0, n - self.full_weight_num)
+            w = np.concatenate([ramp, np.ones(self.full_weight_num)])
+        # transfer priors are the oldest rows; discount their vote (the
+        # device kernel applies the identical multiplier — see
+        # ops/tpe_math.tpe_suggest_fused)
+        if self._n_prior and self.transfer_discount != 1.0:
+            w[: min(self._n_prior, n)] *= self.transfer_discount
+        return w
 
     def _fit_set(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
         """Per-dimension Parzen mixture + category tables for one subset."""
@@ -520,6 +538,7 @@ class TPE(SuggestAhead, BaseAlgorithm):
                 n_eff, count, fit_key,
                 self._n_choices_dev, self._cont_mask_dev,
                 self.gamma, self.prior_weight, self.full_weight_num,
+                self._n_prior, self.transfer_discount,
                 n_cand=self.n_ei_candidates,
                 n_out=pool_w,
                 kmax=self._kmax,

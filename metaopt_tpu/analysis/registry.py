@@ -295,7 +295,7 @@ def default_config() -> LintConfig:
         "CoordServer": {
             "_lock", "_exp_locks_guard", "_snap_lock", "_sig_lock",
             "_replies_lock", "_inflight_lock", "_enc_lock",
-            "_producers_guard", "_map_cv",
+            "_producers_guard", "_map_cv", "_tenant_lock", "_evict_lock",
         },
         "WriteAheadLog": {"_buf_lock", "_cv"},
         "CoordLedgerClient": {"_lock", "_caps_lock", "_live_lock",
@@ -338,6 +338,12 @@ def default_config() -> LintConfig:
         # wire-byte counter increments only; the socket send/recv happen
         # under _lock, not under this one
         "CoordLedgerClient._io_lock",
+        # tenancy map + scheduler arithmetic only (the scheduler is
+        # lock-free by design and serialized entirely under this lock)
+        "CoordServer._tenant_lock",
+        # residency bookkeeping dicts only; evict-file I/O and the WAL
+        # sync happen between acquisitions, never under it
+        "CoordServer._evict_lock",
     }
     cfg.guarded_attrs = {
         "CoordServer": {
@@ -362,6 +368,15 @@ def default_config() -> LintConfig:
             "_exp_inflight": "CoordServer._map_cv",
             "shard_map": "CoordServer._map_cv",
             "_ring": "CoordServer._map_cv",
+            # multi-tenant service plane: experiment→tenant map + the
+            # fair-produce scheduler (lock-free internally, serialized
+            # here), and the residency stubs/touch-stamps/counters
+            "_tenant_of": "CoordServer._tenant_lock",
+            "_sched": "CoordServer._tenant_lock",
+            "_evicted": "CoordServer._evict_lock",
+            "_exp_last_touch": "CoordServer._evict_lock",
+            "_evictions": "CoordServer._evict_lock",
+            "_hydrations": "CoordServer._evict_lock",
         },
         "WriteAheadLog": {
             "_pending": "WriteAheadLog._buf_lock",

@@ -27,7 +27,14 @@ shared state while instrumented:
   client, and a UDS-fast-path client concurrently — so the
   per-address wire/uds caches under ``_caps_lock``, the server's
   wire-keyed encode cache, and the per-connection codec detection all
-  race across codecs.
+  race across codecs. A sixth phase runs two weighted tenants whose
+  experiment fleet is twice the server's residency budget: the
+  housekeeping sweep keeps evicting LRU experiments while workers
+  hydrate them back on first touch, so the tenancy map + WDRR
+  scheduler under ``_tenant_lock`` and the residency bookkeeping
+  (``_evicted``, ``_exp_last_touch``, eviction/hydration counters)
+  under ``_evict_lock`` race the stub-indexed ``tenant_stats`` read
+  path.
 * ``algo`` — CMA-ES (numpy-only: no compile cost inside the detector)
   with ``suggest_prefetch_depth=2``, a driver thread running
   suggest/observe generations against the SuggestAhead refill thread,
@@ -118,6 +125,7 @@ def suite_coord(scale: int = 1) -> None:
     _coord_handoff_phase(scale)
     _coord_batched_phase(scale)
     _coord_mixed_wire_phase(scale)
+    _coord_multitenant_phase(scale)
 
 
 def _coord_sharded_phase(scale: int = 1) -> None:
@@ -403,6 +411,96 @@ def _coord_mixed_wire_phase(scale: int = 1) -> None:
                 t.start()
             for t in threads:
                 t.join(timeout=120.0)
+            if errors:
+                raise errors[0]
+
+
+def _coord_multitenant_phase(scale: int = 1) -> None:
+    """Multi-tenant leg of the coord suite: two weighted tenants own
+    four experiments against a server whose residency budget
+    (``max_resident=2``) is half the fleet, so the housekeeping sweep
+    keeps evicting the LRU experiments while each worker's next touch
+    hydrates its own back. The surface under test is the tenancy map +
+    weighted deficit-round-robin arithmetic under ``_tenant_lock``
+    (produce legs from both tenants racing the window roll), the
+    residency bookkeeping (``_evicted`` stub index, ``_exp_last_touch``,
+    eviction/hydration counters) under ``_evict_lock`` racing the sweep
+    loop, and the stub-indexed ``tenant_stats`` read path — a prober
+    thread hammers it with ``include_experiments=True`` against live
+    evictions, which must never hydrate."""
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+
+    tenants = ("mt-a", "mt-b")
+    per_exp = 6 * scale
+    with tempfile.TemporaryDirectory() as td:
+        snap = os.path.join(td, "coord.snap")
+        with CoordServer(snapshot_path=snap, stale_timeout_s=5.0,
+                         sweep_interval_s=0.1, max_resident=2,
+                         tenant_weights={"mt-a": 2.0, "mt-b": 1.0}) as s:
+            host, port = s.address
+            c0 = CoordLedgerClient(host=host, port=port)
+            names = []
+            for k in range(4):
+                nm = f"race-mt-{k}"
+                c0.create_experiment({
+                    "name": nm, "tenant": tenants[k % 2],
+                    "space": {"x": "uniform(-5, 5)"},
+                    "max_trials": per_exp, "pool_size": 2,
+                    "algorithm": {"random": {"seed": 7 + k}},
+                })
+                names.append(nm)
+            stop = threading.Event()
+            errors: List[BaseException] = []
+
+            def prober() -> None:
+                # the no-hydrate status scan racing live evictions: the
+                # stub index must answer without resurrecting anything
+                try:
+                    c = CoordLedgerClient(host=host, port=port)
+                    while not stop.is_set():
+                        st = c.tenant_stats(include_experiments=True)
+                        if set(st["experiments"]) != set(names):
+                            raise AssertionError(
+                                f"status scan lost experiments: {st}")
+                except BaseException as e:
+                    errors.append(e)
+
+            def worker(i: int) -> None:
+                try:
+                    c = CoordLedgerClient(host=host, port=port)
+                    nm = names[i]
+                    complete = None
+                    for _ in range(per_exp * 12):
+                        out = c.worker_cycle(
+                            nm, f"mtw{i}", pool_size=2, complete=complete)
+                        complete = None
+                        t = out["trial"]
+                        if t is None:
+                            if out["counts"]["completed"] >= per_exp:
+                                return
+                            continue
+                        t.attach_results([{
+                            "name": "objective", "type": "objective",
+                            "value": (t.params["x"] - 1) ** 2,
+                        }])
+                        t.transition("completed")
+                        complete = {"trial": t.to_dict(),
+                                    "expected_status": "reserved",
+                                    "expected_worker": f"mtw{i}"}
+                except BaseException as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        name=f"race-mt-worker-{i}")
+                       for i in range(4)]
+            p = threading.Thread(target=prober, name="race-mt-prober")
+            p.start()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120.0)
+            stop.set()
+            p.join(timeout=30.0)
             if errors:
                 raise errors[0]
 

@@ -161,6 +161,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ls.add_argument("--json", action="store_true", dest="as_json")
 
+    tn = sub.add_parser(
+        "tenants",
+        help="multi-tenant service stats from a coordinator: per-tenant "
+             "produce grants/denials and weights, fleet residency "
+             "(resident/evicted/hydrations), and optionally per-"
+             "experiment status counts — evicted experiments answered "
+             "from their stub index, never hydrated",
+    )
+    tn.add_argument("--config", help="framework config YAML")
+    tn.add_argument("--ledger", help="coord://host:port of the deployment")
+    tn.add_argument("--experiments", action="store_true",
+                    help="include per-experiment status counts")
+    tn.add_argument("--json", action="store_true", dest="as_json")
+
     info = sub.add_parser("info", help="full experiment document + stats")
     common(info)
     info.add_argument("--json", action="store_true", dest="as_json")
@@ -309,6 +323,28 @@ def build_parser() -> argparse.ArgumentParser:
                           "experiment, one WAL+snapshot each) behind a "
                           "router on the public port; --snapshot then "
                           "names a DIRECTORY (one snapshot+WAL per shard)")
+    srv.add_argument("--max-experiments", type=int, default=None,
+                     help="admission control: reject register_experiment "
+                          "past this fleet-wide count (per shard when "
+                          "--shards is set)")
+    srv.add_argument("--max-experiments-per-tenant", type=int, default=None,
+                     help="admission control: per-tenant experiment quota "
+                          "(experiments carry a 'tenant' config key; "
+                          "unset = 'default')")
+    srv.add_argument("--evict-idle-s", type=float, default=None,
+                     help="evict experiments idle this long to crash-"
+                          "atomic evict files (stub stays resident: "
+                          "status counts served without hydration; first "
+                          "touch restores bit-identically)")
+    srv.add_argument("--max-resident", type=int, default=None,
+                     help="LRU residency budget: keep at most this many "
+                          "experiments hydrated (requires --snapshot "
+                          "for the evict directory)")
+    srv.add_argument("--tenant-weights", default=None, metavar="JSON",
+                     help="fair produce scheduling weights, e.g. "
+                          '\'{"acme": 3, "batch": 1}\' — deficit '
+                          "round-robin shares of produce capacity "
+                          "(unlisted tenants weigh 1.0)")
 
     reb = sub.add_parser(
         "rebalance",
@@ -941,6 +977,42 @@ def _cmd_list(args, cfg: Dict[str, Any]) -> int:
 
     for r in roots:
         emit(r, 0)
+    return 0
+
+
+def _cmd_tenants(args, cfg: Dict[str, Any]) -> int:
+    """``mtpu tenants``: the coordinator's multi-tenant service stats."""
+    ledger = _make_ledger_from_spec(args.ledger, cfg)
+    stats_fn = getattr(ledger, "tenant_stats", None)
+    if stats_fn is None:
+        print("tenants needs a coordinator ledger (coord://host:port)",
+              file=sys.stderr)
+        return 2
+    stats = stats_fn(include_experiments=args.experiments)
+    if args.as_json:
+        print(json.dumps(stats, indent=2))
+        return 0
+    print(f"residency: {stats.get('resident', 0)} resident, "
+          f"{stats.get('evicted', 0)} evicted "
+          f"({stats.get('evictions', 0)} evictions, "
+          f"{stats.get('hydrations', 0)} hydrations)")
+    tenants = stats.get("tenants") or {}
+    for tenant in sorted(tenants):
+        row = tenants[tenant]
+        print(f"  {tenant}: {row.get('experiments', 0)} experiments "
+              f"({row.get('evicted', 0)} evicted), weight "
+              f"{row.get('weight', 1.0):g}, produce "
+              f"{row.get('granted', 0)} granted / "
+              f"{row.get('denied', 0)} denied")
+    if args.experiments:
+        per = stats.get("experiments") or {}
+        for name in sorted(per):
+            row = per[name]
+            counts = ", ".join(f"{k}={v}" for k, v in
+                               sorted((row.get("counts") or {}).items()))
+            tag = " [evicted]" if row.get("evicted") else ""
+            print(f"    {name} ({row.get('tenant', 'default')}){tag}: "
+                  f"{counts or 'no trials'}")
     return 0
 
 
@@ -1705,9 +1777,34 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
             if args.suggest_prefetch_depth is not None
             else coord_cfg.get("suggest_prefetch_depth", 1)),
         uds_path=args.uds_path or coord_cfg.get("uds_path"),
+        max_experiments=(args.max_experiments
+                         if args.max_experiments is not None
+                         else coord_cfg.get("max_experiments")),
+        max_experiments_per_tenant=(
+            args.max_experiments_per_tenant
+            if args.max_experiments_per_tenant is not None
+            else coord_cfg.get("max_experiments_per_tenant")),
+        evict_idle_s=(args.evict_idle_s if args.evict_idle_s is not None
+                      else coord_cfg.get("evict_idle_s")),
+        max_resident=(args.max_resident if args.max_resident is not None
+                      else coord_cfg.get("max_resident")),
+        tenant_weights=_tenant_weights(args, coord_cfg),
     )
     serve_forever(server)
     return 0
+
+
+def _tenant_weights(args, coord_cfg: Dict[str, Any]):
+    """--tenant-weights JSON > the config file's coordinator section."""
+    if getattr(args, "tenant_weights", None):
+        import json as _json
+
+        weights = _json.loads(args.tenant_weights)
+        if not isinstance(weights, dict):
+            raise SystemExit("--tenant-weights must be a JSON object "
+                             "mapping tenant -> weight")
+        return {str(k): float(v) for k, v in weights.items()}
+    return coord_cfg.get("tenant_weights")
 
 
 def _serve_sharded(args, coord_cfg: Dict[str, Any], n_shards: int) -> int:
@@ -1743,6 +1840,18 @@ def _serve_sharded(args, coord_cfg: Dict[str, Any], n_shards: int) -> int:
             if args.suggest_prefetch_depth is not None
             else coord_cfg.get("suggest_prefetch_depth", 1)),
         event_log_dir=args.event_log_path,
+        max_experiments=(args.max_experiments
+                         if args.max_experiments is not None
+                         else coord_cfg.get("max_experiments")),
+        max_experiments_per_tenant=(
+            args.max_experiments_per_tenant
+            if args.max_experiments_per_tenant is not None
+            else coord_cfg.get("max_experiments_per_tenant")),
+        evict_idle_s=(args.evict_idle_s if args.evict_idle_s is not None
+                      else coord_cfg.get("evict_idle_s")),
+        max_resident=(args.max_resident if args.max_resident is not None
+                      else coord_cfg.get("max_resident")),
+        tenant_weights=_tenant_weights(args, coord_cfg),
     )
     stop = threading.Event()
     prev = signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -1949,6 +2058,7 @@ _COMMANDS = {
     "db": _cmd_db,
     "info": _cmd_info,
     "list": _cmd_list,
+    "tenants": _cmd_tenants,
     "plot": _cmd_plot,
     "resume": _cmd_resume,
     "status": _cmd_status,

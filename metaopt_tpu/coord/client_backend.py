@@ -55,6 +55,7 @@ from metaopt_tpu.coord.shards import (
     map_version,
 )
 from metaopt_tpu.ledger.backends import (
+    AdmissionError,
     DuplicateExperimentError,
     DuplicateTrialError,
     LedgerBackend,
@@ -67,6 +68,7 @@ log = logging.getLogger(__name__)
 _ERRORS = {
     "DuplicateTrialError": DuplicateTrialError,
     "DuplicateExperimentError": DuplicateExperimentError,
+    "AdmissionError": AdmissionError,
     "KeyError": KeyError,
     "ValueError": ValueError,
 }
@@ -598,6 +600,16 @@ class CoordLedgerClient(LedgerBackend):
 
     def delete_experiment(self, name: str) -> bool:
         return bool(self._call("delete_experiment", name=name))
+
+    def tenant_stats(
+            self, include_experiments: bool = False) -> Dict[str, Any]:
+        """Multi-tenant service stats: per-tenant produce accounting and
+        fleet residency; with ``include_experiments``, per-experiment
+        status counts (evicted experiments answered from their O(1)
+        stub index — this call never hydrates). Against a sharded seed
+        the router fans the op out and merges per-shard accounting."""
+        return self._call("tenant_stats",
+                          include_experiments=bool(include_experiments))
 
     # -- trials ------------------------------------------------------------
     def register(self, trial: Trial) -> None:

@@ -234,6 +234,10 @@ WIRE_OPCODES: Dict[str, int] = {
     "handoff_apply": 23,
     "handoff_abort": 24,
     "shard_map_update": 25,
+    # multi-tenant service plane (read-only: per-tenant produce
+    # accounting + fleet residency; evicted experiments' status counts
+    # come from their stubs, never a hydration)
+    "tenant_stats": 26,
 }
 
 try:  # C-accelerated body codec; absent → v2 is never negotiated

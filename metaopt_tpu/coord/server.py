@@ -90,9 +90,11 @@ from metaopt_tpu.coord.shards import (
     experiment_of,
     map_version,
 )
+from metaopt_tpu.coord.tenancy import FairProduceScheduler
 from metaopt_tpu.coord.wal import WriteAheadLog, fsync_dir, read_records
 from metaopt_tpu.executor.faults import faults
 from metaopt_tpu.ledger.backends import (
+    AdmissionError,
     DuplicateExperimentError,
     LedgerBackend,
     MemoryLedger,
@@ -106,7 +108,10 @@ log = logging.getLogger(__name__)
 CAPS = ("count", "fetch_completed_since", "worker_cycle",
         # worker_cycle's complete leg accepts {"trials": [...]} — the
         # batched hunt pushes a whole evaluated pool in one cycle
-        "worker_cycle_multi") + (
+        "worker_cycle_multi",
+        # multi-tenant service plane (coord/tenancy.py): per-tenant
+        # produce accounting + evicted-experiment status counts
+        "tenant_stats") + (
             # binary wire format v2 (coord/protocol.py): advertised only
             # when the codec is importable, so a msgpack-less build simply
             # never negotiates it and every peer stays on JSON
@@ -304,6 +309,15 @@ class CoordServer:
         shard_id: Optional[str] = None,
         shard_map: Optional[Dict[str, Any]] = None,
         uds_path: Optional[str] = None,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        tenant_quotas: Optional[Dict[str, int]] = None,
+        fair_window_s: float = 0.5,
+        fair_burst: int = 2,
+        max_experiments: Optional[int] = None,
+        max_experiments_per_tenant: Optional[int] = None,
+        evict_idle_s: Optional[float] = None,
+        max_resident: Optional[int] = None,
+        evict_dir: Optional[str] = None,
     ) -> None:
         self.inner = inner if inner is not None else MemoryLedger()
         self._bind = (host, port)
@@ -440,7 +454,44 @@ class CoordServer:
         #: kernel compute; 1 = the historical refill-when-stale default
         self.suggest_prefetch_depth = max(1, int(suggest_prefetch_depth))
 
-    # -- locks / cache plumbing --------------------------------------------
+        # -- multi-tenant service plane (ISSUE 16 / ROADMAP item 1) --------
+        #: admission-control limits for create_experiment; None = unlimited
+        self.max_experiments = max_experiments
+        self.max_experiments_per_tenant = max_experiments_per_tenant
+        #: weighted deficit round-robin over the produce leg of
+        #: worker_cycle — see coord/tenancy.py. Always constructed (cheap);
+        #: with a single active tenant every request is admitted, so the
+        #: historical single-tenant benches/tests are untouched.
+        self._sched = FairProduceScheduler(
+            weights=tenant_weights, quotas=tenant_quotas,
+            window_s=fair_window_s, burst=fair_burst,
+        )
+        #: guards the scheduler + the experiment→tenant map
+        self._tenant_lock = threading.Lock()
+        self._tenant_of: Dict[str, str] = {}
+        #: lazy hydration/eviction: idle experiments' full state (doc,
+        #: trial docs, signals, reply-cache entries, hosted algorithm
+        #: state_dict) moves to a crash-atomic per-experiment file; what
+        #: stays resident is this stub map (doc + O(1) status counts, so
+        #: ``count``/``load_experiment`` answer WITHOUT hydrating).
+        #: Journaled as WAL ``evict``/``hydrate`` records — kill -9
+        #: anywhere in the cycle loses nothing.
+        self.evict_idle_s = evict_idle_s
+        self.max_resident = max_resident
+        # derive the evict dir from the snapshot location ONLY when an
+        # eviction policy is actually configured — a plain snapshotting
+        # server must keep the historical no-fence request path
+        if evict_dir is None and snapshot_path and (
+                evict_idle_s is not None or max_resident is not None):
+            evict_dir = os.path.join(
+                os.path.dirname(os.path.abspath(snapshot_path)), "evict")
+        self.evict_dir = evict_dir
+        self._evict_lock = threading.Lock()
+        self._evicted: Dict[str, Dict[str, Any]] = {}
+        self._exp_last_touch: Dict[str, float] = {}
+        self._evictions = 0
+        self._hydrations = 0
+
     def _exp_lock(self, name: Optional[str]) -> threading.RLock:
         if not name:
             return self._lock
@@ -610,6 +661,54 @@ class CoordServer:
             with self._map_cv:
                 self._migrating.pop(rec["experiment"], None)
             return None
+        if op == "evict":
+            # the record is durable BEFORE the live path drops any state,
+            # so replaying it over a snapshot that still holds the docs
+            # re-executes the drop: delete + stub, never a loss
+            name = rec["experiment"]
+            if isinstance(self.inner, MemoryLedger):
+                self.inner.delete_experiment(name)
+            with self._sig_lock:
+                self._signals = {k: v for k, v in self._signals.items()
+                                 if k[0] != name}
+            with self._evict_lock:
+                self._evicted[name] = {
+                    "path": rec.get("path"),
+                    "counts": rec.get("counts") or {},
+                    "tenant": rec.get("tenant", "default"),
+                    "experiment": rec.get("doc"),
+                }
+            return None
+        if op == "hydrate":
+            # re-apply the evict file (frozen at evict time); mutations
+            # that followed the live hydration replay after this record
+            # and upsert over it. Algorithm state is NOT restored here —
+            # crash recovery rebuilds it by observe-replay, the doctrine
+            # every other recovery path already follows.
+            name = rec["experiment"]
+            with self._evict_lock:
+                stub = self._evicted.pop(name, None)
+            path = rec.get("path") or (stub or {}).get("path")
+            if path and os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        state = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    log.exception("evict file %s unreadable at replay", path)
+                    return None
+                if (self.inner.load_experiment(name) is None
+                        and state.get("experiment")):
+                    self.inner.create_experiment(state["experiment"])
+                have = {t.id for t in self.inner.fetch(name)}
+                for doc in state.get("trials") or []:
+                    if doc["id"] not in have:
+                        self.inner.put_trial(Trial.from_dict(doc))
+                with self._sig_lock:
+                    for sig in state.get("signals") or []:
+                        self._signals[(name, sig["trial_id"])] = sig["signal"]
+                for r in state.get("replies") or []:
+                    self._cache_reply(r["req"], r["reply"], exp=name)
+            return name
         if op == "reply":
             reply = rec["reply"]
             self._cache_reply(rec["req"], reply, exp=rec.get("exp"))
@@ -696,6 +795,23 @@ class CoordServer:
                          now_refreshed)
         if (replayed or torn) and self.snapshot_path:
             self.snapshot(self.snapshot_path)  # also compacts the WAL
+        # rebuild the tenant map (resident docs + evicted stubs) and stamp
+        # every survivor as just-touched — the idle TTL must measure from
+        # the restart, not evict the whole fleet on the first sweep
+        now = time.monotonic()
+        tenants: Dict[str, str] = {}
+        for name in self.inner.list_experiments():
+            doc = self.inner.load_experiment(name) or {}
+            tenants[name] = str(doc.get("tenant") or "default")
+        with self._evict_lock:
+            stubs = {name: str(stub.get("tenant") or "default")
+                     for name, stub in self._evicted.items()}
+            for name in tenants:
+                if name not in self._evicted:
+                    self._exp_last_touch[name] = now
+        with self._tenant_lock:
+            self._tenant_of.update(stubs)
+            self._tenant_of.update(tenants)
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -732,7 +848,10 @@ class CoordServer:
             self._uds_sock = uds
             self._spawn(lambda: self._accept_loop(uds), "coord-accept-uds")
             log.info("coordinator also listening on uds://%s", self.uds_path)
-        if self.stale_timeout_s is not None or self.snapshot_path:
+        if (self.stale_timeout_s is not None or self.snapshot_path
+                or (self._evict_enabled
+                    and (self.evict_idle_s is not None
+                         or self.max_resident is not None))):
             self._spawn(self._housekeeping_loop, "coord-sweep")
         log.info("coordinator listening on %s:%d", *self.address)
         return self
@@ -833,6 +952,12 @@ class CoordServer:
             ):
                 self.snapshot(self.snapshot_path)
                 last_snap = time.time()
+            if self._evict_enabled and (self.evict_idle_s is not None
+                                        or self.max_resident is not None):
+                try:
+                    self.evict_sweep()
+                except Exception:
+                    log.exception("evict sweep failed")
 
     # -- snapshot / restore ------------------------------------------------
     def snapshot(self, path: str) -> None:
@@ -875,6 +1000,16 @@ class CoordServer:
                 "signals": signals,
                 "wal_seq": wal_seq,
             }
+            with self._evict_lock:
+                # compaction drops journaled evict records at or below
+                # wal_seq — the snapshot must carry the stubs or a restart
+                # forgets which experiments live in evict files. Stubs for
+                # experiments captured resident above are skipped (a
+                # non-memory backend keeps docs on disk through eviction).
+                evicted = {n: dict(s) for n, s in self._evicted.items()
+                           if n not in experiments}
+            if evicted:
+                state["evicted"] = evicted
             if smap is not None:
                 # compaction will drop any journaled shard_map adoption
                 # record at or below wal_seq — the snapshot must carry the
@@ -932,6 +1067,12 @@ class CoordServer:
                 for sig in state.get("signals", []):
                     self._signals[(sig["experiment"], sig["trial"])] = (
                         sig["signal"])
+            with self._evict_lock:
+                for name, stub in (state.get("evicted") or {}).items():
+                    # merge semantics match the doc path: resident (or
+                    # already-stubbed) experiments are never overwritten
+                    if name not in existing:
+                        self._evicted.setdefault(name, stub)
             snap_map = state.get("shard_map")
             with self._map_cv:
                 if map_version(snap_map) > map_version(self.shard_map):
@@ -940,6 +1081,284 @@ class CoordServer:
                         self._ring = RoutingTable(snap_map)
         log.info("restored %d experiments from %s", len(state["experiments"]), path)
         return state
+
+    # -- lazy hydration / eviction (ISSUE 16) ------------------------------
+    @property
+    def _evict_enabled(self) -> bool:
+        return self.evict_dir is not None
+
+    #: ops answered from the resident stub's O(1) status-count index —
+    #: they must NOT hydrate an evicted experiment (``mtpu serve`` and
+    #: the scale bench surface fleet-wide counts through these)
+    _NO_HYDRATE_OPS = frozenset(
+        {"count", "load_experiment", "list_experiments"})
+
+    def _evict_file(self, name: str) -> str:
+        assert self.evict_dir is not None
+        return os.path.join(self.evict_dir,
+                            name.replace(os.sep, "_") + ".json")
+
+    def _produce_admit(self, name: str) -> bool:
+        """Fair-scheduling gate on one produce leg (tenancy.py)."""
+        with self._tenant_lock:
+            tenant = self._tenant_of.get(name, "default")
+            return self._sched.admit(tenant)
+
+    def evict_sweep(self) -> int:
+        """One eviction pass: idle-TTL victims first, then LRU victims
+        down to the resident budget. Returns experiments evicted."""
+        now = time.monotonic()
+        with self._evict_lock:
+            touch = dict(self._exp_last_touch)
+            already = set(self._evicted)
+        resident = [n for n in self.inner.list_experiments()
+                    if n not in already]
+        victims = []
+        if self.evict_idle_s is not None:
+            victims = [n for n in resident
+                       if now - touch.get(n, 0.0) >= self.evict_idle_s]
+        if (self.max_resident is not None
+                and len(resident) - len(victims) > self.max_resident):
+            rest = sorted((n for n in resident if n not in set(victims)),
+                          key=lambda n: touch.get(n, 0.0))
+            need = len(resident) - len(victims) - self.max_resident
+            victims.extend(rest[:need])
+        evicted = 0
+        for name in victims:
+            if self._stopping.is_set():
+                break
+            if self.evict_experiment(name):
+                evicted += 1
+        return evicted
+
+    def evict_experiment(self, name: str) -> bool:
+        """Move one experiment's full state to its crash-atomic evict file,
+        leaving only a stub (doc + status counts) resident.
+
+        Fenced exactly like a live hand-off: new ops on the experiment get
+        a retryable ``Migrating`` while in-flight ones drain, so the
+        capture/drop below can never interleave with a dispatch. The evict
+        record is fsynced BEFORE any state is dropped — kill -9 anywhere
+        in the cycle recovers to either fully-resident or cleanly-evicted,
+        never in between (chaos barriers ``crash_evict``).
+        """
+        if not self._evict_enabled:
+            return False
+        with self._map_cv:
+            if name in self._migrating:
+                return False
+            self._migrating[name] = "<evict>"
+            deadline = time.monotonic() + 5.0
+            while self._exp_inflight.get(name, 0) > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._migrating.pop(name, None)
+                    self._map_cv.notify_all()
+                    return False
+                self._map_cv.wait(timeout=min(0.05, remaining))
+        try:
+            return self._evict_fenced(name)
+        finally:
+            with self._map_cv:
+                self._migrating.pop(name, None)
+                self._map_cv.notify_all()
+
+    def _evict_fenced(self, name: str) -> bool:
+        """Capture + journal + drop, with the migration fence held."""
+        with self._evict_lock:
+            if name in self._evicted:
+                return False
+        # the hosted producer leaves memory with the experiment; its
+        # algorithm state rides in the evict file so hydration restores
+        # the surrogate bit-identically instead of re-fitting
+        with self._producers_guard:
+            entry = self._producers.pop(name, None)
+            self._coalescers.pop(name, None)
+        algo_state = None
+        if entry is not None:
+            producer, plock = entry
+            with plock:
+                try:
+                    algo_state = {
+                        "algo": producer.algorithm.state_dict(),
+                        "completed_cursor": producer._completed_cursor,
+                        "warm_started": producer._warm_started,
+                        "algo_done": producer.algo_done,
+                    }
+                except Exception:
+                    log.exception(
+                        "algo state capture failed for %r; hydration "
+                        "falls back to observe-replay", name)
+        with self._exp_lock(name):
+            doc = self.inner.load_experiment(name)
+            if doc is None:
+                return False
+            docs = self.inner.export_docs(name)
+        with self._sig_lock:
+            signals = [{"trial_id": t, "signal": s}
+                       for (e, t), s in self._signals.items() if e == name]
+        with self._replies_lock:
+            replies = [{"req": r, "reply": self._replies[r]}
+                       for r, e in self._reply_exps.items()
+                       if e == name and r in self._replies]
+        counts: Dict[str, int] = {}
+        for d in docs:
+            counts[d["status"]] = counts.get(d["status"], 0) + 1
+        with self._tenant_lock:
+            tenant = self._tenant_of.get(name, "default")
+        state = {"experiment": doc, "trials": docs, "signals": signals,
+                 "replies": replies, "algo": algo_state, "counts": counts,
+                 "tenant": tenant}
+        path = self._evict_file(name)
+        os.makedirs(self.evict_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            # fsync BEFORE the rename — same crash-atomic doctrine as the
+            # snapshot writer: the rename must never land on unwritten data
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        fsync_dir(path)
+        if faults.fire("crash_evict"):
+            # chaos barrier 1: file durable, nothing journaled, nothing
+            # dropped — recovery serves the experiment fully resident
+            os.kill(os.getpid(), _signal_mod.SIGKILL)
+        wal = self._wal
+        if wal is not None:
+            # durable BEFORE the drop: replaying this record over a
+            # snapshot that still holds the docs re-executes the drop
+            wal.append({"op": "evict", "experiment": name, "path": path,
+                        "counts": counts, "tenant": tenant, "doc": doc})
+            wal.sync(wal.appended_seq)
+        if faults.fire("crash_evict"):
+            # chaos barrier 2: journaled durable, memory not yet dropped
+            os.kill(os.getpid(), _signal_mod.SIGKILL)
+        with self._exp_lock(name):
+            if isinstance(self.inner, MemoryLedger):
+                # disk-backed inners (file/native) keep their docs — only
+                # the in-memory plane (handles, producer, caches) drops
+                self.inner.delete_experiment(name)
+            self._mutated(name)
+        release = getattr(self.inner, "release_handle", None)
+        if release is not None:
+            try:
+                release(name)
+            except Exception:
+                log.exception("native handle release failed for %r", name)
+        with self._sig_lock:
+            self._signals = {k: v for k, v in self._signals.items()
+                             if k[0] != name}
+        with self._replies_lock:
+            for req in [r for r, e in self._reply_exps.items()
+                        if e == name]:
+                self._reply_exps.pop(req, None)
+                self._replies.pop(req, None)
+        with self._evict_lock:
+            self._evicted[name] = {"path": path, "counts": counts,
+                                   "tenant": tenant, "experiment": doc}
+            self._evictions += 1
+            self._exp_last_touch.pop(name, None)
+        self._event("evict", name, trials=len(docs))
+        return True
+
+    def hydrate_experiment(self, name: str) -> bool:
+        """Restore one evicted experiment on first touch — bit-identical:
+        trial docs, pending signals, reply-cache entries, and the hosted
+        algorithm's ``state_dict`` all come back exactly as captured."""
+        with self._exp_lock(name):
+            with self._evict_lock:
+                stub = self._evicted.get(name)
+            if stub is None:
+                return False
+            path = stub.get("path") or self._evict_file(name)
+            with open(path) as f:
+                state = json.load(f)
+            if self.inner.load_experiment(name) is None:
+                self.inner.create_experiment(state["experiment"])
+            have = {t.id for t in self.inner.fetch(name)}
+            for doc in state.get("trials") or []:
+                if doc["id"] not in have:
+                    self.inner.put_trial(Trial.from_dict(doc))
+            self._mutated(name)
+        with self._sig_lock:
+            for sig in state.get("signals") or []:
+                self._signals[(name, sig["trial_id"])] = sig["signal"]
+        for r in state.get("replies") or []:
+            self._cache_reply(r["req"], r["reply"], exp=name)
+        algo_state = state.get("algo")
+        if algo_state and self.host_algorithms:
+            try:
+                producer, plock, _ = self._hosted_producer(name)
+                with plock:
+                    producer.algorithm.load_state_dict(algo_state["algo"])
+                    producer._completed_cursor = algo_state.get(
+                        "completed_cursor")
+                    producer._warm_started = bool(
+                        algo_state.get("warm_started"))
+                    producer.algo_done = bool(algo_state.get("algo_done"))
+            except Exception:
+                log.exception("algo state restore failed for %r; "
+                              "observe-replay will rebuild", name)
+        if self._wal is not None:
+            # buffer-only append: the touching op's sender barrier (or any
+            # later mutation's) makes it durable before a dependent ack;
+            # a crash before that replays back to still-evicted, and the
+            # next touch re-hydrates from the same file
+            self._wal.append({"op": "hydrate", "experiment": name,
+                              "path": path})
+        with self._evict_lock:
+            self._evicted.pop(name, None)
+            self._hydrations += 1
+            self._exp_last_touch[name] = time.monotonic()
+        self._event("hydrate", name)
+        return True
+
+    def _tenant_stats(self, a: Dict[str, Any]) -> Dict[str, Any]:
+        """The ``tenant_stats`` op: per-tenant produce accounting +
+        fleet residency, computed WITHOUT hydrating anything. With
+        ``include_experiments`` the reply adds per-experiment status
+        counts (evicted ones answered from their stubs)."""
+        with self._tenant_lock:
+            sched = self._sched.stats()
+            tenant_of = dict(self._tenant_of)
+        with self._evict_lock:
+            evicted = {n: dict(s) for n, s in self._evicted.items()}
+            evictions, hydrations = self._evictions, self._hydrations
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for name, tenant in tenant_of.items():
+            d = tenants.setdefault(tenant, {"experiments": 0, "evicted": 0})
+            d["experiments"] += 1
+            if name in evicted:
+                d["evicted"] += 1
+        for tenant, s in sched.items():
+            tenants.setdefault(
+                tenant, {"experiments": 0, "evicted": 0}).update(s)
+        for tenant, d in tenants.items():
+            # configured weight surfaces even before any produce history
+            d.setdefault("weight", self._sched.weight(tenant))
+        out: Dict[str, Any] = {
+            "tenants": tenants,
+            "resident": max(0, len(tenant_of) - len(evicted)),
+            "evicted": len(evicted),
+            "evictions": evictions,
+            "hydrations": hydrations,
+        }
+        if a.get("include_experiments"):
+            per: Dict[str, Any] = {}
+            for name, tenant in tenant_of.items():
+                stub = evicted.get(name)
+                if stub is not None:
+                    counts = dict(stub.get("counts") or {})
+                else:
+                    counts = {s: self.inner.count(name, s)
+                              for s in ("new", "reserved", "completed")}
+                    counts = {s: c for s, c in counts.items() if c}
+                per[name] = {"tenant": tenant,
+                             "evicted": stub is not None,
+                             "counts": counts}
+            out["experiments"] = per
+        return out
 
     # -- event log ---------------------------------------------------------
     def _event(self, op: str, experiment: Optional[str], **extra: Any) -> None:
@@ -1227,7 +1646,16 @@ class CoordServer:
             done = self.ledger.count(name, ("new", "reserved")) == 0
         if not done:
             producer = plock = None
-            if a.get("produce", True):
+            do_produce = a.get("produce", True)
+            if do_produce and not self._produce_admit(name):
+                # fair scheduling (coord/tenancy.py): this tenant is past
+                # its deficit-round-robin share of produce capacity, so
+                # the produce leg is skipped THIS cycle — the rest of the
+                # cycle (complete/sweep/reserve/counts) ran untouched and
+                # the worker retries the leg next cycle
+                do_produce = False
+                out["throttled"] = True
+            if do_produce:
                 producer, plock, coalescer = self._hosted_producer(name)
                 pres = coalescer.produce(a.get("pool_size"), worker=worker)
                 out["registered"] = pres["registered"]
@@ -1399,6 +1827,10 @@ class CoordServer:
         if faults.fire("crash_handoff_source"):
             # barrier 1 (pre-snapshot): fenced + drained, nothing captured
             os.kill(os.getpid(), _signal_mod.SIGKILL)
+        if self._evict_enabled:
+            # an evicted experiment ships resident: page it back in under
+            # the fence so the capture below sees the full state
+            self.hydrate_experiment(exp)
         wal = self._wal
         try:
             with self._exp_lock(exp):
@@ -1598,13 +2030,16 @@ class CoordServer:
         if op in self._HANDOFF_OPS:
             return self._handle_handoff(op, msg.get("args") or {})
         exp = None
-        if self._ring is not None and op not in ("ping", "snapshot",
-                                                 "list_experiments"):
+        if (self._ring is not None or self._evict_enabled) and op not in (
+                "ping", "snapshot", "list_experiments", "tenant_stats"):
             # sharded serving: refuse experiment-named ops this shard does
             # not own BEFORE any cache or dispatch — accepting one would
             # split the experiment's state across two shards' ledgers.
             # Never cached (a stale-map retry must re-check after the
-            # client refreshes its routing table).
+            # client refreshes its routing table). An eviction-enabled
+            # server runs the same fence + in-flight accounting even
+            # unsharded: evict_experiment drains through it exactly like
+            # a hand-off, so a capture can never interleave a dispatch.
             exp = experiment_of(op, msg.get("args") or {})
             if exp is not None:
                 with self._map_cv:
@@ -1613,13 +2048,14 @@ class CoordServer:
                     # an experiment this shard no longer owns, and the
                     # client must be told to re-learn the map, not to
                     # retry here forever
-                    owner = self._ring.owner(exp)
-                    if owner != self.shard_id:
-                        return {
-                            "ok": False, "error": "WrongShardError",
-                            "msg": f"experiment {exp!r} is owned by shard "
-                                   f"{owner}, not {self.shard_id}",
-                        }
+                    if self._ring is not None:
+                        owner = self._ring.owner(exp)
+                        if owner != self.shard_id:
+                            return {
+                                "ok": False, "error": "WrongShardError",
+                                "msg": f"experiment {exp!r} is owned by "
+                                       f"shard {owner}, not {self.shard_id}",
+                            }
                     dest = self._migrating.get(exp)
                     if dest is not None:
                         return {
@@ -1635,6 +2071,16 @@ class CoordServer:
         if exp is None:
             return self._handle_body(op, msg, wire)
         try:
+            if self._evict_enabled:
+                with self._evict_lock:
+                    self._exp_last_touch[exp] = time.monotonic()
+                    stubbed = exp in self._evicted
+                if stubbed and op not in self._NO_HYDRATE_OPS:
+                    try:
+                        self.hydrate_experiment(exp)
+                    except Exception as e:
+                        return {"ok": False, "error": type(e).__name__,
+                                "msg": str(e)}
             return self._handle_body(op, msg, wire)
         finally:
             with self._map_cv:
@@ -1663,6 +2109,13 @@ class CoordServer:
                 producer, plock, coalescer = self._hosted_producer(
                     a["experiment"])
                 if op == "produce":
+                    if not self._produce_admit(a["experiment"]):
+                        # fair-scheduling skip, same contract as the
+                        # worker_cycle leg: registered=0 is the workon
+                        # loop's ordinary idle signal, retried next cycle
+                        return {"ok": True, "result": {
+                            "registered": 0, "algo_done": False,
+                            "coalesced": 1, "throttled": True}}
                     # concurrent produce RPCs group-commit: one combined
                     # cycle per coalescing window (event emitted there)
                     result: Any = coalescer.produce(
@@ -1798,19 +2251,72 @@ class CoordServer:
                 reply["shard_id"] = self.shard_id
             return reply
         if op == "create_experiment":
-            self.ledger.create_experiment(a["config"])
-            self._event("create_experiment", a["config"].get("name"))
+            cfg = a["config"]
+            name = cfg.get("name")
+            tenant = str(cfg.get("tenant") or "default")
+            with self._evict_lock:
+                if name in self._evicted:
+                    # the name exists — its state just lives in an evict
+                    # file; admitting a second life would fork identity
+                    raise DuplicateExperimentError(name)
+            if (self.max_experiments is not None
+                    or self.max_experiments_per_tenant is not None):
+                # admission-control gate: reject past configured limits
+                # BEFORE the ledger write; callers see AdmissionError and
+                # must shed load, it is not a retryable race
+                with self._tenant_lock:
+                    known = name in self._tenant_of
+                    total = len(self._tenant_of)
+                    mine = sum(1 for t in self._tenant_of.values()
+                               if t == tenant)
+                if not known:
+                    if (self.max_experiments is not None
+                            and total >= self.max_experiments):
+                        raise AdmissionError(
+                            f"server at capacity ({total} experiments, "
+                            f"limit {self.max_experiments})")
+                    if (self.max_experiments_per_tenant is not None
+                            and mine >= self.max_experiments_per_tenant):
+                        raise AdmissionError(
+                            f"tenant {tenant!r} at quota ({mine} "
+                            "experiments, limit "
+                            f"{self.max_experiments_per_tenant})")
+            self.ledger.create_experiment(cfg)
+            with self._tenant_lock:
+                self._tenant_of[name] = tenant
+            if self._evict_enabled:
+                with self._evict_lock:
+                    self._exp_last_touch[name] = time.monotonic()
+            self._event("create_experiment", name)
             return None
+        if op == "tenant_stats":
+            return self._tenant_stats(a)
         if op == "load_experiment":
+            if self._evict_enabled:
+                with self._evict_lock:
+                    stub = self._evicted.get(a["name"])
+                if stub is not None and stub.get("experiment") is not None:
+                    return stub["experiment"]
             return self.ledger.load_experiment(a["name"])
         if op == "update_experiment":
             self.ledger.update_experiment(a["name"], a["patch"])
             return None
         if op == "list_experiments":
-            return self.ledger.list_experiments()
+            names = self.ledger.list_experiments()
+            if self._evict_enabled:
+                with self._evict_lock:
+                    extra = [n for n in self._evicted if n not in set(names)]
+                if extra:
+                    names = list(names) + sorted(extra)
+            return names
         if op == "delete_experiment":
             name = a["name"]
             ok = bool(self.ledger.delete_experiment(name))
+            with self._tenant_lock:
+                self._tenant_of.pop(name, None)
+            with self._evict_lock:
+                self._evicted.pop(name, None)
+                self._exp_last_touch.pop(name, None)
             if ok:
                 # pending signals die with the docs. The hosted producer
                 # is popped later, OUTSIDE the ledger locks (the
@@ -1873,6 +2379,20 @@ class CoordServer:
             status = a.get("status")
             if isinstance(status, list):
                 status = tuple(status)
+            if self._evict_enabled:
+                with self._evict_lock:
+                    stub = self._evicted.get(a["experiment"])
+                if stub is not None:
+                    # answered from the stub's O(1) status-count index —
+                    # frozen at evict time and exact, because every
+                    # mutating op hydrates first (satellite: fleet-wide
+                    # status sweeps must not page the fleet back in)
+                    counts = stub.get("counts") or {}
+                    if status is None:
+                        return sum(counts.values())
+                    if isinstance(status, tuple):
+                        return sum(counts.get(s, 0) for s in status)
+                    return counts.get(status, 0)
             return self.ledger.count(a["experiment"], status)
         if op == "fetch_completed_since":
             trials, cur = self.ledger.fetch_completed_since(

@@ -80,7 +80,7 @@ SHARD_MAP_CAP = "shard_map"
 
 #: the ops the router answers itself rather than relaying; a v2 request
 #: whose header opcode is outside this set is routed WITHOUT decoding
-_PAN_SHARD_OPS = ("ping", "list_experiments", "snapshot")
+_PAN_SHARD_OPS = ("ping", "list_experiments", "snapshot", "tenant_stats")
 _PAN_SHARD_OPCODES = frozenset(WIRE_OPCODES[op] for op in _PAN_SHARD_OPS)
 
 
@@ -93,6 +93,35 @@ def stable_hash(key: str) -> int:
     """
     return int.from_bytes(hashlib.md5(key.encode("utf-8")).digest()[:8],
                           "big")
+
+
+def merge_tenant_stats(parts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-shard ``tenant_stats`` replies into one pod-wide view.
+
+    Counters are additive (each shard only grants produce legs for the
+    experiments it owns); a tenant's weight is configuration, identical
+    on every shard, so any shard's value stands.
+    """
+    out: Dict[str, Any] = {
+        "tenants": {}, "resident": 0, "evicted": 0,
+        "evictions": 0, "hydrations": 0,
+    }
+    for part in parts:
+        if not isinstance(part, dict):
+            continue
+        for key in ("resident", "evicted", "evictions", "hydrations"):
+            out[key] += int(part.get(key) or 0)
+        for tenant, row in (part.get("tenants") or {}).items():
+            acc = out["tenants"].setdefault(tenant, {
+                "granted": 0, "denied": 0, "experiments": 0,
+                "evicted": 0, "weight": row.get("weight", 1.0),
+            })
+            for key in ("granted", "denied", "experiments", "evicted"):
+                acc[key] += int(row.get(key) or 0)
+        if "experiments" in part:
+            out.setdefault("experiments", {}).update(
+                part["experiments"] or {})
+    return out
 
 
 def experiment_of(op: Optional[str], args: Dict[str, Any]) -> Optional[str]:
@@ -644,6 +673,23 @@ class ShardRouter:
                         else:
                             self._send_reply(conn, bad, wire)
                         continue
+                    if op == "tenant_stats":
+                        # per-shard tenant accounting merges additively:
+                        # each shard grants produce legs only for the
+                        # experiments it owns, so summing counters (and
+                        # unioning residency) is the pod-wide truth
+                        replies = self._fanout(msg, upstream)
+                        bad = next(
+                            (r for r in replies if not r.get("ok")), None)
+                        if bad is None:
+                            self._send_reply(conn, {
+                                "ok": True,
+                                "result": merge_tenant_stats(
+                                    [r["result"] for r in replies]),
+                            }, wire)
+                        else:
+                            self._send_reply(conn, bad, wire)
+                        continue
                     exp = experiment_of(op, msg.get("args") or {})
                     self._relay(conn, payload, exp, upstream)
                 except (ConnectionError, BrokenPipeError, OSError,
@@ -739,6 +785,11 @@ class ShardSupervisor:
         suggest_prefetch_depth: int = 1,
         event_log_dir: Optional[str] = None,
         produce_coalesce_ms: Optional[float] = None,
+        evict_idle_s: Optional[float] = None,
+        max_resident: Optional[int] = None,
+        max_experiments: Optional[int] = None,
+        max_experiments_per_tenant: Optional[int] = None,
+        tenant_weights: Optional[Dict[str, float]] = None,
     ) -> None:
         if n_shards < 1:
             raise ValueError("need at least one shard")
@@ -751,6 +802,14 @@ class ShardSupervisor:
         self.suggest_prefetch_depth = suggest_prefetch_depth
         self.event_log_dir = event_log_dir
         self.produce_coalesce_ms = produce_coalesce_ms
+        # multi-tenant knobs — forwarded verbatim to every shard; the
+        # per-tenant admission caps apply PER SHARD (the router does not
+        # pre-count), which is the conservative reading of a pod-wide cap
+        self.evict_idle_s = evict_idle_s
+        self.max_resident = max_resident
+        self.max_experiments = max_experiments
+        self.max_experiments_per_tenant = max_experiments_per_tenant
+        self.tenant_weights = tenant_weights
         self.vnodes = vnodes
         self.ready_timeout_s = ready_timeout_s
         self._want_router = router
@@ -907,6 +966,19 @@ class ShardSupervisor:
         if self.produce_coalesce_ms is not None:
             argv += ["--produce-coalesce-ms",
                      str(self.produce_coalesce_ms)]
+        if self.evict_idle_s is not None:
+            argv += ["--evict-idle-s", str(self.evict_idle_s)]
+        if self.max_resident is not None:
+            argv += ["--max-resident", str(self.max_resident)]
+        if self.max_experiments is not None:
+            argv += ["--max-experiments", str(self.max_experiments)]
+        if self.max_experiments_per_tenant is not None:
+            argv += ["--max-experiments-per-tenant",
+                     str(self.max_experiments_per_tenant)]
+        if self.tenant_weights:
+            argv += ["--tenant-weights",
+                     json.dumps(self.tenant_weights,
+                                separators=(",", ":"))]
         return argv
 
     def _spawn(self, i: int, env_extra: Optional[Dict[str, str]] = None,
@@ -1089,6 +1161,12 @@ def _shard_main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--event-log", default=None)
     ap.add_argument("--suggest-prefetch-depth", type=int, default=1)
     ap.add_argument("--produce-coalesce-ms", type=float, default=None)
+    ap.add_argument("--evict-idle-s", type=float, default=None)
+    ap.add_argument("--max-resident", type=int, default=None)
+    ap.add_argument("--max-experiments", type=int, default=None)
+    ap.add_argument("--max-experiments-per-tenant", type=int, default=None)
+    ap.add_argument("--tenant-weights", default=None,
+                    help="tenant→weight map as inline JSON")
     a = ap.parse_args(argv)
 
     from metaopt_tpu.coord.server import CoordServer, serve_forever
@@ -1096,6 +1174,16 @@ def _shard_main(argv: Optional[List[str]] = None) -> None:
     extra: Dict[str, Any] = {}
     if a.produce_coalesce_ms is not None:
         extra["produce_coalesce_ms"] = a.produce_coalesce_ms
+    if a.evict_idle_s is not None:
+        extra["evict_idle_s"] = a.evict_idle_s
+    if a.max_resident is not None:
+        extra["max_resident"] = a.max_resident
+    if a.max_experiments is not None:
+        extra["max_experiments"] = a.max_experiments
+    if a.max_experiments_per_tenant is not None:
+        extra["max_experiments_per_tenant"] = a.max_experiments_per_tenant
+    if a.tenant_weights:
+        extra["tenant_weights"] = json.loads(a.tenant_weights)
     serve_forever(CoordServer(
         host=a.host,
         port=a.port,

@@ -177,6 +177,11 @@ def record_experiment(rec: Dict[str, Any]) -> Optional[str]:
         return rec.get("name")
     if op == "set_signal":
         return rec.get("experiment")
+    if op in ("evict", "hydrate"):
+        # lazy eviction lifecycle (server._evict_fenced / hydrate): a
+        # hand-off extracting an experiment's tail must carry these or
+        # the destination replays trial records over a stale residency
+        return rec.get("experiment")
     if op == "reply":
         return rec.get("exp")
     return None

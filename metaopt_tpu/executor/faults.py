@@ -60,6 +60,17 @@ selector walks the barriers in order):
   prefix of the shipped trial docs journaled — recovery replays the
   partial prefix harmlessly and the orchestrator's retried apply
   completes the move.
+
+Eviction kind (consumed in ``coord/server.py``; the lazy
+hydration/eviction plane of the multi-tenant service):
+
+- ``crash_evict``: die mid-eviction — skip 0 fires after the evict
+  file is fsynced but before the WAL evict record (recovery serves
+  the experiment fully resident; the orphaned file is harmless),
+  skip 1 fires after the record is durable but before any state is
+  dropped (recovery replays the drop and comes back cleanly
+  evicted). Either way no acknowledged write is lost — there is no
+  in-between state.
 """
 
 from __future__ import annotations

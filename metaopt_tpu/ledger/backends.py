@@ -51,6 +51,13 @@ class DuplicateExperimentError(RuntimeError):
     """Raised when two creators race on the same experiment name."""
 
 
+class AdmissionError(RuntimeError):
+    """Raised when a multi-tenant coordinator refuses ``create_experiment``
+    past its configured limits (``max_experiments`` /
+    ``max_experiments_per_tenant``) — the admission-control gate. Not a
+    retryable race: the caller must shed load or raise its quota."""
+
+
 class LedgerBackend(ABC):
     """Storage + concurrency contract. All methods are atomic per call."""
 

@@ -228,6 +228,8 @@ def tpe_suggest_fused(
     gamma,               # scalar: good-set quantile
     prior_weight,        # scalar: prior pseudo-count / pseudo-component weight
     full_weight_num,     # scalar int32: recency ramp cutoff
+    n_prior=0,           # scalar int32: rows 0..n_prior-1 are transfer priors
+    transfer_discount=1.0,  # scalar: weight multiplier on those rows
     *,
     n_cand: int,
     n_out: int,
@@ -273,6 +275,10 @@ def tpe_suggest_fused(
     # host; never let a rounding divergence index past the prior row
     n_below = jnp.minimum(n_below, n_good_pad - 1)
     w_obs = _recency_weights(n, idx, full_weight_num, equal_weight)
+    # transfer priors (EVC warm-start) occupy the OLDEST rows; their
+    # evidence is discounted so locally-measured points dominate the fit
+    # as soon as they exist. Traced scalars: no new compile variants.
+    w_obs = w_obs * jnp.where(idx < n_prior, transfer_discount, 1.0)
     ng = jnp.minimum(n_below, n)
     nb = jnp.maximum(n - n_below, 0)
 

@@ -43,6 +43,12 @@ class Producer:
             # the surrogate starts informed, trial identity stays local
             self._warm_started = True
             meta = exp.metadata or {}
+            # transfer priors seed FIRST: they must occupy the oldest
+            # observation rows so the algorithm's n_prior discount (TPE
+            # weights / GP subsample) addresses exactly them
+            transfer = meta.get("transfer_from")
+            if transfer:
+                self._seed_transfer_priors(transfer, meta)
             branch = meta.get("branch")
             # both can be set at once: the branch parent replays through the
             # space adapter, an additional warm-start source through the
@@ -119,6 +125,52 @@ class Producer:
                 len(trials) - len(kept), len(trials),
             )
         return len(kept)
+
+    def _seed_transfer_priors(self, transfer, meta) -> None:
+        """Seed the algorithm from EVC-admissible ancestors (ISSUE 16c).
+
+        ``metadata.transfer_from`` names ancestor experiments directly
+        (a string or list of names), or the sentinel ``"evc"`` which
+        resolves the branch-parent chain via
+        :func:`metaopt_tpu.ledger.evc.branch_parent`. Each ancestor's
+        completed trials are space-remapped through the same
+        :class:`TrialAdapter` path as branch warm-start (an inadmissible
+        ancestor degrades to the in-space filter, never poisons the fit)
+        and fed to ``observe_prior`` — tagged prior rows the acquisition
+        discounts against locally-measured evidence.
+        """
+        exp = self.experiment
+        items = [transfer] if isinstance(transfer, str) else list(transfer)
+        names = []
+        for item in items:
+            if item == "evc":
+                from metaopt_tpu.ledger.evc import branch_parent
+
+                seen = {exp.name}
+                parent = branch_parent(
+                    {"name": exp.name, "metadata": meta})
+                while parent and parent not in seen and len(names) < 8:
+                    names.append(parent)
+                    seen.add(parent)
+                    doc = exp.ledger.load_experiment(parent)
+                    parent = branch_parent(doc) if doc else None
+            elif item != exp.name and item not in names:
+                names.append(item)
+        for src in names:
+            try:
+                fetched = exp.ledger.fetch(src, "completed")
+            except Exception as err:
+                log.warning("transfer ancestor %r unreadable: %s", src, err)
+                continue
+            usable = self._adapt_foreign(
+                fetched, src, {"defaults": None, "renames": None})
+            usable = [t for t in usable if t.objective is not None]
+            if usable:
+                self.algorithm.observe_prior(usable)
+            log.info(
+                "transfer priors: seeded %d/%d completed trials from %r",
+                len(usable), len(fetched), src,
+            )
 
     def _adapt_foreign(self, fetched, src, branch):
         """Fit another experiment's trials to this space (EVC branch path)."""

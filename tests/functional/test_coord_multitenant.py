@@ -1,0 +1,477 @@
+"""Multi-tenant experiment service tests (ISSUE 16).
+
+Covers the three planes the tentpole added to the coordinator:
+
+- **Fair produce scheduling** — the windowed weighted deficit
+  round-robin in :mod:`metaopt_tpu.coord.tenancy` (unit-tested with a
+  fake clock: work conservation, the hot-tenant cap, weights, absolute
+  quotas, active-set aging) plus the ``create_experiment``
+  admission-control gate (global + per-tenant ``AdmissionError``).
+- **Lazy hydration/eviction** — evict→hydrate round-trips are
+  bit-identical for the hosted algorithm's ``state_dict``, journaled
+  reply-cache entries, and in-flight reservations; status counts answer
+  from the evicted stub's O(1) index without hydrating anything.
+- **Transfer priors** — ``metadata.transfer_from`` (named ancestors and
+  the ``"evc"`` chain walk) seeds the algorithm's prior-observation
+  rows through :class:`~metaopt_tpu.worker.producer.Producer` before
+  the first suggest.
+
+The kill -9 chaos sweep at the eviction durability barriers rides at
+the bottom (``slow``-marked, subprocess-hosted, same supervisor shape
+as ``test_coord_crash.py``): the evict file is fsynced before the WAL
+record, the record before the drop, so a crash at either barrier must
+recover to fully-resident or cleanly-evicted — never in between.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+from metaopt_tpu.coord.tenancy import FairProduceScheduler, jain_index
+from metaopt_tpu.ledger import Experiment, MemoryLedger, Trial
+from metaopt_tpu.space import build_space
+from metaopt_tpu.ledger.backends import (
+    AdmissionError,
+    DuplicateExperimentError,
+)
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# -- fair produce scheduling (fake clock, no server) ----------------------
+
+
+def test_jain_index():
+    assert jain_index([]) == 1.0
+    assert jain_index([0, 0, 0]) == 1.0
+    assert jain_index([5, 5, 5, 5]) == pytest.approx(1.0)
+    # one tenant taking everything floors at 1/n
+    assert jain_index([12, 0, 0, 0]) == pytest.approx(0.25)
+    assert 0.25 < jain_index([8, 2, 2, 2]) < 1.0
+
+
+class TestFairProduceScheduler:
+    def test_single_tenant_work_conservation(self):
+        s = FairProduceScheduler(window_s=10.0, burst=2)
+        assert all(s.admit("solo", now=0.01 * i) for i in range(200))
+        assert s.total_granted["solo"] == 200
+        assert s.total_denied.get("solo", 0) == 0
+
+    def test_hot_tenant_capped_when_contended(self):
+        s = FairProduceScheduler(window_s=100.0, burst=2)
+        assert s.admit("small", now=0.0)
+        assert s.admit("hot", now=0.0)
+        # equal weights, share 0.5: the hot tenant's holdings stall at
+        # held >= 0.5*(held+2)+2, i.e. 6 grants, while small sits at 1
+        outcomes = [s.admit("hot", now=0.1) for _ in range(50)]
+        assert s.total_granted["hot"] == 6
+        assert outcomes.count(False) == 45
+        # the small tenant is nowhere near its share: still admitted
+        assert s.admit("small", now=0.2)
+        assert s.total_denied.get("small", 0) == 0
+
+    def test_weights_shift_the_cap(self):
+        lo = FairProduceScheduler(window_s=100.0, burst=0)
+        hi = FairProduceScheduler(
+            weights={"hot": 3.0}, window_s=100.0, burst=0)
+        for s in (lo, hi):
+            s.admit("small", now=0.0)
+            for _ in range(100):
+                s.admit("hot", now=0.1)
+        assert hi.total_granted["hot"] > lo.total_granted["hot"]
+
+    def test_absolute_quota_overrides_fair_share(self):
+        s = FairProduceScheduler(quotas={"batch": 2}, window_s=100.0)
+        # even alone (work conservation would admit), the quota caps it
+        grants = [s.admit("batch", now=0.0) for _ in range(5)]
+        assert grants == [True, True, False, False, False]
+        # the window roll refills the quota
+        assert s.admit("batch", now=200.0)
+
+    def test_idle_tenant_ages_out_of_the_active_set(self):
+        s = FairProduceScheduler(
+            window_s=1000.0, burst=2, active_window_s=2.0)
+        s.admit("small", now=0.0)
+        while s.admit("hot", now=0.1):
+            pass  # drive hot to its contended cap
+        denied = s.total_denied["hot"]
+        assert denied > 0
+        # small stops requesting; once it ages out the active set is
+        # {hot} alone and every request is granted again — capacity
+        # shifts, it is never parked
+        assert s.admit("hot", now=5.0)
+        assert s.total_denied["hot"] == denied
+
+    def test_stats_shape(self):
+        s = FairProduceScheduler(weights={"a": 2.0}, window_s=100.0)
+        s.admit("a", now=0.0)
+        s.admit("b", now=0.0)
+        st = s.stats()
+        assert st["a"] == {"granted": 1, "denied": 0, "weight": 2.0}
+        assert st["b"]["weight"] == 1.0
+
+
+# -- admission control ----------------------------------------------------
+
+
+def test_create_experiment_admission_gate():
+    with CoordServer(max_experiments=3,
+                     max_experiments_per_tenant=2) as s:
+        host, port = s.address
+        c = CoordLedgerClient(host=host, port=port)
+
+        def doc(name, tenant):
+            return {"name": name, "tenant": tenant,
+                    "space": {"x": "uniform(0, 1)"}, "max_trials": 10}
+
+        c.create_experiment(doc("a-1", "acme"))
+        c.create_experiment(doc("a-2", "acme"))
+        with pytest.raises(AdmissionError, match="tenant"):
+            c.create_experiment(doc("a-3", "acme"))
+        c.create_experiment(doc("b-1", "beta"))  # global slot 3
+        with pytest.raises(AdmissionError, match="limit 3"):
+            c.create_experiment(doc("b-2", "beta"))
+        # a lost creation race is NOT an admission failure: the denied
+        # doc above must not have consumed a slot, and a duplicate name
+        # keeps its own error type
+        with pytest.raises(DuplicateExperimentError):
+            c.create_experiment(doc("a-1", "acme"))
+        assert sorted(c.list_experiments()) == ["a-1", "a-2", "b-1"]
+
+
+# -- lazy hydration / eviction --------------------------------------------
+
+
+def _drive(client, name, worker, n):
+    """Complete ``n`` trials through the fused worker_cycle loop."""
+    complete = None
+    done = 0
+    for _ in range(n * 20):
+        out = client.worker_cycle(name, worker, pool_size=4,
+                                  complete=complete)
+        complete = None
+        t = out["trial"]
+        if t is None:
+            if out["counts"]["completed"] >= n:
+                return
+            continue
+        t.attach_results([{"name": "objective", "type": "objective",
+                           "value": (t.params["x"] - 0.3) ** 2}])
+        t.transition("completed")
+        complete = {"trial": t.to_dict(), "expected_status": "reserved",
+                    "expected_worker": worker}
+        done += 1
+        if done >= n:
+            client.update_trial(t, expected_status="reserved",
+                                expected_worker=worker)
+            return
+    raise AssertionError(f"never completed {n} trials")
+
+
+def test_evict_hydrate_bit_identity(tmp_path):
+    """Evict→hydrate restores the hosted algorithm state_dict, the
+    journaled reply-cache entries, and in-flight reservations exactly."""
+    with CoordServer(host_algorithms=True,
+                     evict_dir=str(tmp_path / "evict"),
+                     stale_timeout_s=60.0) as s:
+        host, port = s.address
+        c = CoordLedgerClient(host=host, port=port)
+        c.create_experiment({
+            "name": "bits", "tenant": "acme",
+            "space": {"x": "uniform(0, 1)"}, "max_trials": 100,
+            "pool_size": 4,
+            "algorithm": {"tpe": {"seed": 3, "n_initial_points": 2}},
+        })
+        _drive(c, "bits", "w0", 6)
+        # leave one reservation in flight across the round-trip
+        cyc = c.worker_cycle("bits", "w-held", pool_size=4)
+        held = cyc["trial"]
+        assert held is not None
+
+        prod, plock = s._producers["bits"]
+        with plock:
+            prod.produce()  # observe everything completed so far
+            state_before = prod.algorithm.state_dict()
+        with s._replies_lock:
+            replies_before = {
+                r: s._replies[r] for r, e in s._reply_exps.items()
+                if e == "bits" and r in s._replies}
+        docs_before = {t.id: t.to_dict() for t in c.fetch("bits")}
+        assert replies_before and docs_before
+
+        assert s.evict_experiment("bits")
+        assert "bits" in s._evicted
+        assert "bits" not in s._producers
+
+        # first touch hydrates (fetch is not a stub-answerable op)
+        docs_after = {t.id: t.to_dict() for t in c.fetch("bits")}
+        assert "bits" not in s._evicted
+        assert docs_after == docs_before
+        assert c.count("bits", status="reserved") == 1
+        rdoc = next(d for d in docs_after.values()
+                    if d["status"] == "reserved")
+        assert rdoc["id"] == held.id and rdoc["worker"] == "w-held"
+
+        prod2, plock2 = s._producers["bits"]
+        assert prod2 is not prod  # rebuilt, not leaked
+        with plock2:
+            assert prod2.algorithm.state_dict() == state_before
+        with s._replies_lock:
+            replies_after = {
+                r: s._replies[r] for r, e in s._reply_exps.items()
+                if e == "bits" and r in s._replies}
+        for r, reply in replies_before.items():
+            assert replies_after.get(r) == reply
+
+
+def test_status_counts_answer_from_stub_without_hydrating(tmp_path):
+    with CoordServer(evict_dir=str(tmp_path / "evict"),
+                     stale_timeout_s=60.0) as s:
+        host, port = s.address
+        c = CoordLedgerClient(host=host, port=port)
+        for name, tenant, n in (("cold", "acme", 5), ("warm", "beta", 3)):
+            c.create_experiment({
+                "name": name, "tenant": tenant,
+                "space": {"x": "uniform(0, 1)"}, "max_trials": 100})
+            for i in range(n):
+                c.register(Trial(params={"x": i / 10.0}, experiment=name))
+        assert s.evict_experiment("cold")
+
+        st = c.tenant_stats(include_experiments=True)
+        assert st["resident"] == 1 and st["evicted"] == 1
+        assert st["tenants"]["acme"]["evicted"] == 1
+        assert st["experiments"]["cold"] == {
+            "tenant": "acme", "evicted": True, "counts": {"new": 5}}
+        assert st["experiments"]["warm"]["counts"] == {"new": 3}
+        # count/load_experiment answer from the stub index too
+        assert c.count("cold", status="new") == 5
+        assert c.count("cold", status="completed") == 0
+        # none of the above resurrected anything
+        assert "cold" in s._evicted
+        assert st["hydrations"] == 0 and s._hydrations == 0
+
+
+def test_evict_sweep_lru_respects_max_resident(tmp_path):
+    with CoordServer(snapshot_path=str(tmp_path / "snap.json"),
+                     max_resident=2, stale_timeout_s=60.0,
+                     sweep_interval_s=3600.0) as s:
+        host, port = s.address
+        c = CoordLedgerClient(host=host, port=port)
+        for i in range(5):
+            c.create_experiment({
+                "name": f"lru-{i}", "space": {"x": "uniform(0, 1)"},
+                "max_trials": 10})
+        # freshen 4 then 3: the sweep must keep the two most recent
+        c.count("lru-4")
+        time.sleep(0.01)
+        c.count("lru-3")
+        assert s.evict_sweep() == 3
+        assert sorted(s._evicted) == ["lru-0", "lru-1", "lru-2"]
+        assert s.evict_sweep() == 0  # idempotent at the budget
+
+
+# -- transfer priors ------------------------------------------------------
+
+
+def _completed(led, name, n, seed_x=0.3):
+    for i in range(n):
+        t = Trial(params={"x": min(1.0, seed_x + 0.01 * i)},
+                  experiment=name)
+        led.register(t)
+        got = led.reserve(name, "seed")
+        got.attach_results([{"name": "objective", "type": "objective",
+                             "value": (got.params["x"] - 0.3) ** 2}])
+        got.transition("completed")
+        led.update_trial(got, expected_status="reserved",
+                         expected_worker="seed")
+
+
+def test_transfer_priors_from_named_ancestors():
+    from metaopt_tpu.algo import TPE
+    from metaopt_tpu.worker.producer import Producer
+
+    led = MemoryLedger()
+    led.create_experiment({"name": "anc", "space": {"x": "uniform(0, 1)"},
+                           "max_trials": 100})
+    _completed(led, "anc", 7)
+    exp = Experiment(
+        "child", led, space=build_space({"x": "uniform(0, 1)"}),
+        max_trials=50, metadata={"transfer_from": ["anc"]},
+    ).configure()
+    prod = Producer(exp, TPE(exp.space, seed=1, n_initial_points=3))
+    assert prod.produce(1) == 1
+    # all 7 ancestor completions landed as discounted prior rows
+    assert prod.algorithm.n_prior == 7
+    assert len(prod.algorithm._observed) == 7
+
+
+def test_transfer_priors_evc_resolves_the_branch_chain():
+    from metaopt_tpu.algo import TPE
+    from metaopt_tpu.worker.producer import Producer
+
+    led = MemoryLedger()
+    led.create_experiment({"name": "grand",
+                           "space": {"x": "uniform(0, 1)"},
+                           "max_trials": 100})
+    _completed(led, "grand", 4)
+    led.create_experiment({"name": "parent",
+                           "space": {"x": "uniform(0, 1)"},
+                           "max_trials": 100,
+                           "metadata": {"branch": {"parent": "grand"}}})
+    _completed(led, "parent", 3, seed_x=0.5)
+    exp = Experiment(
+        "leaf", led, space=build_space({"x": "uniform(0, 1)"}),
+        max_trials=50, metadata={"transfer_from": "evc",
+                                 "branch": {"parent": "parent"}},
+    ).configure()
+    prod = Producer(exp, TPE(exp.space, seed=1, n_initial_points=3))
+    assert prod.produce(1) == 1
+    # "evc" walked leaf → parent → grand; the branch warm-start replay
+    # of the parent dedups against the prior rows instead of doubling
+    assert prod.algorithm.n_prior == 7
+    assert len(prod.algorithm._observed) == 7
+
+
+# -- kill -9 chaos at the eviction durability barriers --------------------
+
+# eviction-enabled subprocess server: idle experiments evict after 2 s,
+# which is where the armed crash_evict barrier fires
+_SERVER_SRC = """
+import sys
+from metaopt_tpu.coord.server import CoordServer, serve_forever
+serve_forever(CoordServer(
+    port=int(sys.argv[1]), snapshot_path=sys.argv[2], stale_timeout_s=60.0,
+    evict_idle_s=2.0, sweep_interval_s=0.1,
+))
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Supervisor:
+    """Restart-on-exit babysitter (test_coord_crash.py shape)."""
+
+    def __init__(self, snap, port, faults=""):
+        self.snap, self.port = snap, port
+        self._stop = threading.Event()
+        self._procs = []
+        self._spawn(faults)
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def _spawn(self, faults):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   METAOPT_TPU_FAULTS=faults)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SERVER_SRC, str(self.port), self.snap],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO, env=env,
+        )
+        for line in proc.stdout:
+            if "coordinator ready" in line:
+                break
+        else:
+            raise AssertionError("server failed to start")
+        self._procs.append(proc)
+        return proc
+
+    def _watch(self):
+        while not self._stop.is_set():
+            if self._procs[-1].poll() is not None:
+                self._spawn("")  # restart CLEAN: one kill per test
+            time.sleep(0.02)
+
+    def crashes(self):
+        return sum(1 for p in self._procs[:-1]
+                   if p.returncode == -signal.SIGKILL)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5)
+            proc.stdout.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "skip, evicted_after",
+    [
+        # barrier 1: evict file durable, NOTHING journaled, nothing
+        # dropped — recovery serves the experiment fully resident
+        (0, False),
+        # barrier 2: WAL evict record durable, memory not yet dropped —
+        # recovery replays the drop and comes back cleanly evicted
+        (1, True),
+    ],
+)
+def test_kill9_during_eviction(tmp_path, skip, evicted_after):
+    snap = str(tmp_path / "snap.json")
+    port = _free_port()
+    sup = _Supervisor(snap, port, faults=f"crash_evict:1@{skip}")
+    client = CoordLedgerClient(host="127.0.0.1", port=port,
+                               reconnect_window_s=60.0)
+    try:
+        client.create_experiment({
+            "name": "chaos-evict", "tenant": "acme",
+            "space": {"x": "uniform(0, 100)"},
+            "algorithm": {"random": {"seed": 0}}, "max_trials": 1000})
+        acked = []
+        for i in range(12):
+            t = Trial(params={"x": float(i)}, experiment="chaos-evict")
+            client.register(t)
+            acked.append(t.id)
+        cyc = client.worker_cycle("chaos-evict", "w0", produce=False)
+        reserved_id = cyc["trial"].id
+        # go idle: the 2 s idle TTL evicts, the armed barrier SIGKILLs
+        deadline = time.monotonic() + 30.0
+        while sup.crashes() == 0:
+            assert time.monotonic() < deadline, "the fault never fired"
+            time.sleep(0.05)
+
+        # the restarted server stamps survivors just-touched at recovery,
+        # so the immediate post-restart residency is the barrier's verdict
+        st = client.tenant_stats(include_experiments=True)
+        entry = st["experiments"]["chaos-evict"]
+        assert entry["evicted"] is evicted_after
+        # either way the stub/resident counts hold every acked write —
+        # and reading them hydrated nothing
+        assert entry["counts"] == {"new": 11, "reserved": 1}
+        assert st["hydrations"] == 0
+
+        # first real touch: all 12 acked trials and the reservation are
+        # intact (hydrated from the evict file for barrier 2)
+        docs = client.fetch("chaos-evict")
+        assert sorted(t.id for t in docs) == sorted(acked)
+        reserved = [t for t in docs if t.status == "reserved"]
+        assert [t.id for t in reserved] == [reserved_id]
+        assert reserved[0].worker == "w0"
+    finally:
+        sup.stop()
+        client = None
+
+    # final on-disk state replays clean under a policy-free server
+    with CoordServer(snapshot_path=snap) as verify:
+        vc = CoordLedgerClient(host=verify.address[0],
+                               port=verify.address[1])
+        ids = [t.id for t in vc.fetch("chaos-evict")]
+        assert len(ids) == len(set(ids)), "duplicate registrations"
+        assert set(acked) <= set(ids), "acknowledged writes lost"
