@@ -1049,7 +1049,6 @@ def main() -> None:
         "unit": result["unit"],
         "vs_baseline": result["vs_baseline"],
         "backend": backend,
-        "stale": backend != "tpu",
         # a TPU run whose model stages all deadlined still exits 0 — the
         # stage-error count lets consumers (watch_tpu.py) reject a gutted
         # capture instead of checkpointing it as done. The profile stage
@@ -1065,6 +1064,15 @@ def main() -> None:
     }
     if value_tpu_last_good is not None:
         compact["value_tpu_last_good"] = value_tpu_last_good
+    # staleness is PER METRIC, not global: on a CPU-fallback run only the
+    # rows carried from the last-good TPU artifact are stale — everything
+    # measured live this run (the headline `value`, the control-plane
+    # coord_* keys below) is fresh, it just says backend=cpu. The old
+    # global `stale: backend != "tpu"` flag branded live CPU measurements
+    # (e.g. tpe_suggest_ms_per_point_10k_obs_pool8 in r05) as stale.
+    stale_keys = []
+    if value_tpu_last_good is not None:
+        stale_keys.append("value_tpu_last_good")
     for key in ("mfu_seq256", "mfu_seq512", "mfu_seq1024", "resnet50_mfu",
                 # pre-gate-change records (xent routing measured 2026-08-01)
                 # carry the product-routing MFU under the _matxent A/B tag;
@@ -1083,6 +1091,8 @@ def main() -> None:
                 "flash_vs_chunked_crossover"):
         if key in src:
             compact[key] = src[key]
+            if tpu_record_from != "live":
+                stale_keys.append(key)
     # control-plane keys come from the LIVE extra, not the last-good TPU
     # record: they are host-CPU metrics, fresh on every run. The GP ratio
     # keys ride here too — the incremental-vs-full-refit speedup and hit
@@ -1105,6 +1115,10 @@ def main() -> None:
                 "coord_evict_rss_ratio", "transfer_warm_trials_ratio"):
         if key in result["extra"]:
             compact[key] = result["extra"][key]
+    # `stale` keeps its warn-never-fail contract for consumers that only
+    # look at the flag; `stale_keys` names exactly which rows it covers
+    compact["stale"] = bool(stale_keys)
+    compact["stale_keys"] = sorted(stale_keys)
     print(json.dumps(compact))
 
 

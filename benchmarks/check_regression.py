@@ -98,6 +98,25 @@ EVICT_RSS_FLOOR = 3.0
 TRANSFER_METRIC = "transfer_warm_trials_ratio"
 TRANSFER_CEILING = 0.5
 MT_TPS_METRIC = "coord_trials_per_s_1k_exp"
+#: columnar completed-trial archive (ISSUE 17). Drift watches (lower is
+#: better, informational until a committed baseline carries them): bytes
+#: of coordinator RSS per completed trial at 1M, wall-clock of one
+#: incremental snapshot at 1M, and the serve-loop p99 pause while
+#: snapshots run. Single-shot host figures, so they gate with the wide
+#: hand-off-style slack, not the 10% throughput threshold.
+ARCHIVE_DRIFT_METRICS = ("coord_rss_bytes_per_trial_1m",
+                         "coord_snapshot_ms_1m",
+                         "coord_serve_pause_ms_p99")
+ARCHIVE_SLACK = 0.50
+#: same-run ratio floors that ENFORCE the moment the artifact carries
+#: them (the tentpole's acceptance bars, substrate-independent): the
+#: archived coordinator must hold ≥5x less RSS than the all-resident
+#: control, and an incremental snapshot of a clean-but-one fleet must
+#: beat a full dump by ≥10x
+ARCHIVE_RSS_METRIC = "coord_archive_rss_ratio"
+ARCHIVE_RSS_FLOOR = 5.0
+SNAP_SPEEDUP_METRIC = "coord_snapshot_incr_speedup"
+SNAP_SPEEDUP_FLOOR = 10.0
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -108,6 +127,31 @@ def newest_artifact() -> str:
         raise SystemExit("no bench artifact under benchmarks/results/ — "
                          "run `python bench.py` first")
     return max(paths, key=os.path.getmtime)
+
+
+def archive_summary() -> dict:
+    """Summary row of the newest committed archive_scale artifact.
+
+    Returns the gate-relevant keys plus ``_source`` (the file it came
+    from), or ``{}`` when no artifact carries a summary row.
+    """
+    paths = sorted(glob.glob(os.path.join(REPO, "benchmarks", "results",
+                                          "archive_scale_*.jsonl")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                rows = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, ValueError):
+            continue
+        for row in reversed(rows):
+            if row.get("kind") == "summary":
+                keep = {k: row[k] for k in
+                        (ARCHIVE_RSS_METRIC, SNAP_SPEEDUP_METRIC,
+                         *ARCHIVE_DRIFT_METRICS, "commit", "trials")
+                        if k in row}
+                keep["_source"] = os.path.basename(path)
+                return keep
+    return {}
 
 
 def load_artifact(path: str) -> dict:
@@ -483,6 +527,65 @@ def main() -> int:
             rc = 1
         else:
             print(f"OK {mt_verdict}")
+
+    # columnar trial archive: the two same-run ratios enforce their
+    # absolute floors whenever the artifact carries them; the drift
+    # watches gate (lower is better) with the wide slack against the
+    # last committed baseline that carries each — informational until one.
+    # The 1M-scale probes live in benchmarks/archive_scale.py, far too
+    # heavy for bench.py's live pass — so when the bench artifact lacks
+    # the keys, fall back to the newest committed archive_scale summary
+    # row (same-run ratios, so substrate drift cannot fake a pass)
+    aext = archive_summary()
+    if aext and any(extra.get(k) is None for k in
+                    (ARCHIVE_RSS_METRIC, SNAP_SPEEDUP_METRIC)):
+        print(f"archive gates: riding {aext.pop('_source')} "
+              f"(commit {aext.get('commit', '?')}, "
+              f"{aext.get('trials', '?')} trials)")
+        for k, v in aext.items():
+            extra.setdefault(k, v)
+    arss = extra.get(ARCHIVE_RSS_METRIC)
+    if arss is None:
+        print(f"{ARCHIVE_RSS_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif float(arss) < ARCHIVE_RSS_FLOOR:
+        print(f"FAIL {ARCHIVE_RSS_METRIC}: {float(arss):.2f}x < the "
+              f"{ARCHIVE_RSS_FLOOR:.0f}x residency floor (the archive is "
+              "not flattening per-trial RSS)")
+        rc = 1
+    else:
+        print(f"OK {ARCHIVE_RSS_METRIC}: {float(arss):.2f}x "
+              f"(floor {ARCHIVE_RSS_FLOOR:.0f}x)")
+    snsp = extra.get(SNAP_SPEEDUP_METRIC)
+    if snsp is None:
+        print(f"{SNAP_SPEEDUP_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif float(snsp) < SNAP_SPEEDUP_FLOOR:
+        print(f"FAIL {SNAP_SPEEDUP_METRIC}: {float(snsp):.2f}x < the "
+              f"{SNAP_SPEEDUP_FLOOR:.0f}x incremental-snapshot floor "
+              "(O(dirty) is not beating the full dump)")
+        rc = 1
+    else:
+        print(f"OK {SNAP_SPEEDUP_METRIC}: {float(snsp):.2f}x "
+              f"(floor {SNAP_SPEEDUP_FLOOR:.0f}x)")
+    for akey in ARCHIVE_DRIFT_METRICS:
+        aval = extra.get(akey)
+        a_bases = [b for b in matching if b[3].get(akey) is not None]
+        if aval is None or not a_bases:
+            print(f"{akey}: artifact or committed baseline missing the "
+                  "metric — nothing to gate against (pass)")
+            continue
+        ab_name, _, _, ab_parsed = a_bases[-1]
+        a_base = float(ab_parsed[akey])
+        aratio = float(aval) / a_base if a_base else 0.0
+        averdict = (f"{akey}: {float(aval):.3g} vs {a_base:.3g} "
+                    f"({ab_name}, {art['backend']}) → {aratio:.3f}x")
+        if a_base and aratio > 1.0 + ARCHIVE_SLACK:
+            print(f"FAIL {averdict} — regressed past the "
+                  f"{ARCHIVE_SLACK:.0%} slack")
+            rc = 1
+        else:
+            print(f"OK {averdict}")
     return rc
 
 

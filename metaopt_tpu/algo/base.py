@@ -205,7 +205,17 @@ class BaseAlgorithm(ABC):
         """Propose up to ``num`` new points (param dicts incl. fidelity)."""
 
     def observe(self, trials: Sequence[Trial]) -> None:
-        """Ingest completed trials. Idempotent per trial id (replay-safe)."""
+        """Ingest completed trials. Idempotent per trial id (replay-safe).
+
+        Tries the columnar fast path first: when the sequence is a
+        columnar batch (the ledger archive's ``CompletedBatch``) and the
+        subclass ingests it wholesale via :meth:`_observe_batch`, no
+        per-trial ``Trial`` objects are materialized at all. Any refusal
+        (the default hook, a plain list, exotic rows) falls back to the
+        per-trial loop — same stream, same idempotency.
+        """
+        if len(trials) and self._observe_batch(trials):
+            return
         for t in trials:
             if t.id in self._observed:
                 continue
@@ -214,6 +224,16 @@ class BaseAlgorithm(ABC):
                 continue
             self._observed[t.id] = obj
             self._observe_one(t)
+
+    def _observe_batch(self, trials: Sequence[Trial]) -> bool:
+        """Columnar ingest hook. Subclasses that can consume a whole
+        batch straight from its value columns (``CompletedBatch.
+        columns()``) override this and return True when the batch is
+        FULLY ingested — including the ``_observed`` idempotency
+        bookkeeping ``observe`` otherwise does per trial. Returning
+        False (the default) routes the batch through the per-trial path.
+        """
+        return False
 
     def _observe_one(self, trial: Trial) -> None:  # subclass hook
         pass

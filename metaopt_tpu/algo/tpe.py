@@ -171,6 +171,44 @@ class TPE(SuggestAhead, BaseAlgorithm):
             self.cube.transform(trial.params), np.float32))
         self._y.append(float(trial.objective))
 
+    def _observe_batch(self, trials) -> bool:
+        # mtpu: holds(_kernel_lock)  (observe() wraps super().observe())
+        columns = getattr(trials, "columns", None)
+        if columns is None:
+            return False
+        batch = columns()
+        if batch is None:
+            # non-columnar rows (overflow docs, mixed param keys): let the
+            # per-trial path materialize and ingest them one by one
+            return False
+        ids, cols, y = batch
+        keep, seen = [], set()
+        for i, tid in enumerate(ids):
+            # replay-safe like the per-trial path, including duplicates
+            # WITHIN one batch (a revived-and-recompleted trial appears
+            # twice in the completion log tail)
+            if tid in self._observed or tid in seen:
+                continue
+            seen.add(tid)
+            keep.append(i)
+        if not keep:
+            return True
+        if len(keep) != len(ids):
+            cols = {k: [v[i] for i in keep] for k, v in cols.items()}
+            y = y[keep]
+            ids = [ids[i] for i in keep]
+        # one column-major transform for the whole batch — bit-identical
+        # per row to the transform(t.params) calls _observe_one would make
+        X32 = np.asarray(
+            self.cube.transform_columns(cols, len(ids)), np.float32)
+        for i, tid in enumerate(ids):
+            val = float(y[i])
+            self._observed[tid] = val
+            # copy: a row VIEW would pin the whole batch matrix in memory
+            self._X.append(X32[i].copy())
+            self._y.append(val)
+        return True
+
     def observe(self, trials: List[Trial]) -> None:
         with self._kernel_lock:
             super().observe(trials)

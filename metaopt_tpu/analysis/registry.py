@@ -231,6 +231,7 @@ def default_race_config() -> RaceConfig:
         "WriteAheadLog": "metaopt_tpu.coord.wal",
         "CoordLedgerClient": "metaopt_tpu.coord.client_backend",
         "MemoryLedger": "metaopt_tpu.ledger.backends",
+        "ExperimentArchive": "metaopt_tpu.ledger.archive",
         "CMAES": "metaopt_tpu.algo.cmaes",
         "ShardRouter": "metaopt_tpu.coord.shards",
         "ShardSupervisor": "metaopt_tpu.coord.shards",
@@ -301,6 +302,7 @@ def default_config() -> LintConfig:
         "CoordLedgerClient": {"_lock", "_caps_lock", "_live_lock",
                               "_io_lock"},
         "MemoryLedger": {"_lock"},
+        "ExperimentArchive": {"_seg_lock"},
         "_ProduceCoalescer": {"_guard"},
         "SuggestAhead": {"_ahead_lock"},
         "ShardRouter": {"_conns_lock", "_map_lock"},
@@ -322,6 +324,8 @@ def default_config() -> LintConfig:
         "CoordServer._producers_guard",
         "WriteAheadLog._buf_lock",
         "MemoryLedger._lock",
+        # columnar seal/decode only — pure in-memory work, no I/O under it
+        "ExperimentArchive._seg_lock",
         "CoordLedgerClient._caps_lock",
         "CoordLedgerClient._live_lock",
         # both guard only in-memory container snapshots; socket shutdown /
@@ -377,6 +381,11 @@ def default_config() -> LintConfig:
             "_exp_last_touch": "CoordServer._evict_lock",
             "_evictions": "CoordServer._evict_lock",
             "_hydrations": "CoordServer._evict_lock",
+            # incremental-snapshot state: the per-experiment section cache
+            # and the segment-id → on-disk-file dedup map, touched by the
+            # housekeeping snapshot and on-demand snapshot RPCs alike
+            "_snap_sections": "CoordServer._snap_lock",
+            "_seg_on_disk": "CoordServer._snap_lock",
         },
         "WriteAheadLog": {
             "_pending": "WriteAheadLog._buf_lock",
@@ -393,6 +402,9 @@ def default_config() -> LintConfig:
             # open compaction fences (hand-off tail extraction): compact()
             # polls it under the cv exactly like _syncing
             "_fence": "WriteAheadLog._cv",
+            # per-thread fence depths (re-entrancy: a fence holder's own
+            # compact() must not deadlock on its own fence)
+            "_fence_owners": "WriteAheadLog._cv",
         },
         "CoordLedgerClient": {
             "_caps": "CoordLedgerClient._caps_lock",
@@ -444,6 +456,22 @@ def default_config() -> LintConfig:
             "_new_heap": "MemoryLedger._lock",
             "_completed_log": "MemoryLedger._lock",
             "_exp_gen": "MemoryLedger._lock",
+            # per-experiment columnar archives (completed-trial storage)
+            "_archives": "MemoryLedger._lock",
+        },
+        "ExperimentArchive": {
+            # sealed segments + mutable head + the id→position liveness
+            # index: appends/seals from the ledger's write path race
+            # snapshot exports and fetch materialization
+            "_segments": "ExperimentArchive._seg_lock",
+            "_head": "ExperimentArchive._seg_lock",
+            "_head_live": "ExperimentArchive._seg_lock",
+            "_head_pos": "ExperimentArchive._seg_lock",
+            "_skeys": "ExperimentArchive._seg_lock",
+            "_svals": "ExperimentArchive._seg_lock",
+            "_odd": "ExperimentArchive._seg_lock",
+            "_live_sealed": "ExperimentArchive._seg_lock",
+            "_seg_seq": "ExperimentArchive._seg_lock",
         },
         "SuggestAhead": {
             # speculative-refill pool bookkeeping: the spawn decision and

@@ -126,6 +126,107 @@ def suite_coord(scale: int = 1) -> None:
     _coord_batched_phase(scale)
     _coord_mixed_wire_phase(scale)
     _coord_multitenant_phase(scale)
+    _coord_archive_phase(scale)
+
+
+def _coord_archive_phase(scale: int = 1) -> None:
+    """Columnar-archive leg of the coord suite: a tiny ``segment_rows``
+    makes every few completions seal a segment while the housekeeping
+    loop takes incremental snapshots, so the archive's seal/append path
+    (``_seg_lock`` under ``MemoryLedger._lock``) races snapshot capture
+    (section cache + segment export under ``_snap_lock``), lazy batch
+    materialization (``fetch_completed_since`` readers walking cursors
+    across live sealing), and revivals flipping sealed rows dead from a
+    worker thread."""
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+    from metaopt_tpu.ledger import Experiment, Trial
+    from metaopt_tpu.space import build_space
+
+    workers = 4
+    budget = workers * 6 * scale
+    with tempfile.TemporaryDirectory() as td:
+        snap = os.path.join(td, "coord.snap")
+        with CoordServer(snapshot_path=snap, snapshot_interval_s=0.05,
+                         stale_timeout_s=5.0, sweep_interval_s=0.05,
+                         archive_segment_rows=4) as s:
+            host, port = s.address
+            c0 = CoordLedgerClient(host=host, port=port)
+            Experiment(
+                "race-archive", c0,
+                space=build_space({"x": "uniform(-5, 5)"}),
+                max_trials=budget * 2, pool_size=workers,
+                algorithm={"random": {"seed": 7}},
+            ).configure()
+            stop = threading.Event()
+            errors: List[BaseException] = []
+
+            def worker(i: int) -> None:
+                try:
+                    c = CoordLedgerClient(host=host, port=port)
+                    done = 0
+                    while done < budget // workers:
+                        t = Trial(params={"x": float(i * 100 + done)},
+                                  experiment="race-archive")
+                        c.register(t)
+                        got = c.reserve("race-archive", f"aw{i}")
+                        if got is None:
+                            continue
+                        got.attach_results([{
+                            "name": "objective", "type": "objective",
+                            "value": (got.params["x"] - 1) ** 2,
+                        }])
+                        got.transition("completed")
+                        if c.update_trial(got, expected_status="reserved"):
+                            done += 1
+                except BaseException as e:
+                    errors.append(e)
+
+            def reader() -> None:
+                # cursor walker: batches materialize lazily off segments
+                # that are being sealed (and snapshotted) under it
+                try:
+                    c = CoordLedgerClient(host=host, port=port)
+                    cur = None
+                    while not stop.is_set():
+                        batch, cur = c.fetch_completed_since(
+                            "race-archive", cur)
+                        for t in batch:
+                            assert t.status == "completed"
+                except BaseException as e:
+                    errors.append(e)
+
+            def reviver() -> None:
+                # flip completed rows back to new (dead-row path) and let
+                # the workers re-complete them
+                try:
+                    c = CoordLedgerClient(host=host, port=port)
+                    while not stop.is_set():
+                        done = c.fetch("race-archive", "completed")
+                        for t in done[:2]:
+                            t.status = "new"
+                            t.worker = None
+                            t.results = []
+                            c.update_trial(t, expected_status="completed")
+                        stop.wait(0.02)
+                except BaseException as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        name=f"race-archive-worker-{i}")
+                       for i in range(workers)]
+            threads.append(threading.Thread(target=reader,
+                                            name="race-archive-reader"))
+            threads.append(threading.Thread(target=reviver,
+                                            name="race-archive-reviver"))
+            for t in threads:
+                t.start()
+            for t in threads[:workers]:
+                t.join(timeout=120.0)
+            stop.set()
+            for t in threads[workers:]:
+                t.join(timeout=30.0)
+            if errors:
+                raise errors[0]
 
 
 def _coord_sharded_phase(scale: int = 1) -> None:

@@ -303,6 +303,27 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--snapshot", dest="snapshot_path", default=None,
                      help="snapshot file for crash/resume")
     srv.add_argument("--snapshot-interval-s", type=float, default=30.0)
+    srv.add_argument("--snapshot-full", dest="snapshot_full",
+                     action="store_true",
+                     help="force full (v1) snapshots: every experiment's "
+                          "whole doc set reserialized each time, no "
+                          "segment files (default: incremental v2 "
+                          "manifests — sealed archive segments written "
+                          "once under <snapshot>.segments/, only dirty "
+                          "experiments re-captured)")
+    srv.add_argument("--archive-segment-rows", dest="archive_segment_rows",
+                     type=int, default=None, metavar="N",
+                     help="completed-trial archive segment size: completed "
+                          "trials seal into immutable columnar segments "
+                          "of N rows (default 4096) — flat RSS per trial "
+                          "and O(dirty) incremental snapshots at "
+                          "million-trial scale")
+    srv.add_argument("--no-trial-archive", dest="trial_archive",
+                     action="store_false", default=True,
+                     help="keep completed trials as resident Trial "
+                          "objects instead of sealing them into the "
+                          "columnar archive (debugging escape hatch; "
+                          "RSS grows with every completion)")
     srv.add_argument("--stale-timeout-s", type=float, default=120.0,
                      help="pacemaker: re-free reservations idle this long")
     srv.add_argument("--event-log", dest="event_log_path", default=None,
@@ -1770,6 +1791,12 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
         port=args.port if args.port is not None else coord_cfg.get("port", 0),
         snapshot_path=args.snapshot_path,
         snapshot_interval_s=args.snapshot_interval_s,
+        snapshot_incremental=not getattr(args, "snapshot_full", False),
+        archive_segment_rows=(
+            args.archive_segment_rows
+            if getattr(args, "archive_segment_rows", None) is not None
+            else coord_cfg.get("archive_segment_rows")),
+        archive_completed=getattr(args, "trial_archive", True),
         stale_timeout_s=args.stale_timeout_s,
         event_log_path=args.event_log_path,
         suggest_prefetch_depth=(

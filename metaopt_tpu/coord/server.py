@@ -296,6 +296,7 @@ class CoordServer:
         port: int = 0,
         snapshot_path: Optional[str] = None,
         snapshot_interval_s: float = 30.0,
+        snapshot_incremental: bool = True,
         stale_timeout_s: Optional[float] = None,
         sweep_interval_s: float = 5.0,
         event_log_path: Optional[str] = None,
@@ -318,8 +319,16 @@ class CoordServer:
         evict_idle_s: Optional[float] = None,
         max_resident: Optional[int] = None,
         evict_dir: Optional[str] = None,
+        archive_segment_rows: Optional[int] = None,
+        archive_completed: bool = True,
     ) -> None:
-        self.inner = inner if inner is not None else MemoryLedger()
+        if inner is not None:
+            self.inner = inner
+        else:
+            kw: Dict[str, Any] = {"archive_completed": archive_completed}
+            if archive_segment_rows is not None:
+                kw["archive_segment_rows"] = int(archive_segment_rows)
+            self.inner = MemoryLedger(**kw)
         self._bind = (host, port)
         #: same-host fast path: also listen on this Unix domain socket and
         #: advertise it in the ping reply — pod-local clients that can
@@ -330,6 +339,13 @@ class CoordServer:
         self._uds_sock: Optional[socket.socket] = None
         self.snapshot_path = snapshot_path
         self.snapshot_interval_s = snapshot_interval_s
+        #: incremental snapshots (v2 manifest): sealed archive segments
+        #: are written to ``<snapshot>.segments/<seg_id>.json`` exactly
+        #: once and referenced by id; only dirty experiments and the
+        #: mutable head reserialize per snapshot — O(dirty), not O(total).
+        #: Engages only when the inner backend exposes the archive API
+        #: (MemoryLedger); other backends keep the full v1 dump.
+        self.snapshot_incremental = bool(snapshot_incremental)
         self.stale_timeout_s = stale_timeout_s
         self.sweep_interval_s = sweep_interval_s
         self.event_log_path = event_log_path
@@ -387,6 +403,18 @@ class CoordServer:
         self._exp_locks: Dict[str, threading.RLock] = {}
         self._exp_locks_guard = threading.Lock()
         self._snap_lock = threading.Lock()  # serializes snapshot file writes
+        #: experiment → (mutation counter, manifest section) — the O(dirty)
+        #: core of incremental snapshots: a clean experiment's section is
+        #: reused verbatim, skipping its capture AND reserialization
+        self._snap_sections: Dict[str, Tuple[int, Dict[str, Any]]] = {}
+        #: segment id → file name, for segments already durably written
+        #: under ``<snapshot>.segments/`` (written once, content immutable)
+        self._seg_on_disk: Dict[str, str] = {}
+        #: deferred-snapshot request (post-delete durability): the serving
+        #: thread sets it, the housekeeping loop snapshots — a delete no
+        #: longer pays a whole snapshot on the request path when the WAL
+        #: already journals it durably
+        self._snap_soon = threading.Event()
         self._signals: Dict[Tuple[str, str], str] = {}  # (exp, trial_id) → signal
         self._sig_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
@@ -946,10 +974,13 @@ class CoordServer:
                     for t in released:
                         self._event("release_stale", name, trial=t.id)
                 last_sweep = time.time()
-            if (
-                self.snapshot_path
-                and time.time() - last_snap >= self.snapshot_interval_s
+            if self.snapshot_path and (
+                self._snap_soon.is_set()
+                or time.time() - last_snap >= self.snapshot_interval_s
             ):
+                # _snap_soon: a serving thread handed off post-delete
+                # durability work rather than paying for a snapshot on
+                # the request path (the WAL already journals the delete)
                 self.snapshot(self.snapshot_path)
                 last_snap = time.time()
             if self._evict_enabled and (self.evict_idle_s is not None
@@ -961,8 +992,20 @@ class CoordServer:
 
     # -- snapshot / restore ------------------------------------------------
     def snapshot(self, path: str) -> None:
-        """Backend-agnostic full dump; atomic replace so a crash mid-write
-        never corrupts the previous snapshot.
+        """Durable state dump; atomic replace so a crash mid-write never
+        corrupts the previous snapshot.
+
+        Two formats behind one entry point:
+
+        * **v1 (full)** — backend-agnostic: every experiment's full doc set
+          in one JSON file. Used when ``snapshot_incremental`` is off or
+          the inner backend has no columnar archive.
+        * **v2 (incremental)** — sealed archive segments are written to
+          ``<path>.segments/<seg_id>.json`` exactly once (their content is
+          immutable) and referenced from the manifest; a clean experiment's
+          manifest section is reused from ``_snap_sections`` without
+          re-capturing or re-serializing anything. Snapshot cost is
+          O(dirty experiments + new segments), not O(total trials).
 
         ``_snap_lock`` covers capture AND write: the housekeeping thread and
         ``stop()`` may snapshot concurrently, and interleaving their
@@ -973,72 +1016,226 @@ class CoordServer:
         experiments are never stalled by a multi-MB capture.
         """
         with self._snap_lock:
-            wal = self._wal
-            # read BEFORE capture: any record <= this seq was appended
-            # under its experiment's lock before capture takes that lock,
-            # so the capture reflects it; records > it stay in the WAL
-            # tail and replay idempotently over this snapshot
-            wal_seq = wal.appended_seq if wal is not None else 0
-            experiments: Dict[str, Any] = {}
-            trials: Dict[str, Any] = {}
-            for name in self.inner.list_experiments():
+            # any deferred-snapshot request up to this point is satisfied
+            # by the capture below; deletes landing mid-capture are
+            # journaled in the WAL tail and re-request via _snap_soon
+            self._snap_soon.clear()
+            if (self.snapshot_incremental
+                    and hasattr(self.inner, "archive_segment_refs")):
+                self._snapshot_v2_locked(path)
+            else:
+                self._snapshot_v1_locked(path)
+
+    # mtpu: holds(_snap_lock)
+    def _snapshot_v1_locked(self, path: str) -> None:
+        wal = self._wal
+        # read BEFORE capture: any record <= this seq was appended
+        # under its experiment's lock before capture takes that lock,
+        # so the capture reflects it; records > it stay in the WAL
+        # tail and replay idempotently over this snapshot
+        wal_seq = wal.appended_seq if wal is not None else 0
+        experiments: Dict[str, Any] = {}
+        trials: Dict[str, Any] = {}
+        for name in self.inner.list_experiments():
+            with self._exp_lock(name):
+                experiments[name] = self.inner.load_experiment(name)
+                trials[name] = self.inner.export_docs(name)
+        state = {
+            "version": 1,
+            "ts": time.time(),
+            "experiments": experiments,
+            "trials": trials,
+            "wal_seq": wal_seq,
+        }
+        self._snapshot_globals(state, experiments)
+        self._snapshot_commit(path, state, wal, wal_seq)
+
+    # mtpu: holds(_snap_lock)
+    def _snapshot_v2_locked(self, path: str) -> None:
+        wal = self._wal
+        # read BEFORE capture, same ordering argument as v1
+        wal_seq = wal.appended_seq if wal is not None else 0
+        seg_dir = path + ".segments"
+        sections: Dict[str, Dict[str, Any]] = {}
+        for name in self.inner.list_experiments():
+            with self._exp_lock(name):
+                mut = self._mut.get(name, 0)
+                cached = self._snap_sections.get(name)
+                if cached is not None and cached[0] == mut:
+                    # clean since its last capture: reuse the section —
+                    # this is the O(dirty) payoff
+                    sections[name] = cached[1]
+                    continue
+                config = self.inner.load_experiment(name)
+                docs = self.inner.export_mutable_docs(name)
+                refs = self.inner.archive_segment_refs(name)
+            # segment persistence cycles export-then-write per segment:
+            # export under the experiment lock (a concurrent
+            # delete_experiment cannot drop the archive between listing
+            # refs and exporting rows), the fsync-heavy file write outside
+            # it — and only one segment's docs are ever resident at a
+            # time, so the first snapshot after a restart stays flat-RSS
+            # even at millions of archived rows
+            missing = False
+            for ref in refs:
+                if self._seg_on_disk.get(ref["seg"]) is not None:
+                    continue
                 with self._exp_lock(name):
-                    experiments[name] = self.inner.load_experiment(name)
-                    trials[name] = self.inner.export_docs(name)
-            with self._sig_lock:
-                signals = [
-                    {"experiment": e, "trial": t, "signal": s}
-                    for (e, t), s in self._signals.items()
-                ]
-            with self._map_cv:
-                smap = self.shard_map
-            state = {
-                "version": 1,
-                "ts": time.time(),
-                "experiments": experiments,
-                "trials": trials,
-                "signals": signals,
-                "wal_seq": wal_seq,
-            }
-            with self._evict_lock:
-                # compaction drops journaled evict records at or below
-                # wal_seq — the snapshot must carry the stubs or a restart
-                # forgets which experiments live in evict files. Stubs for
-                # experiments captured resident above are skipped (a
-                # non-memory backend keeps docs on disk through eviction).
-                evicted = {n: dict(s) for n, s in self._evicted.items()
-                           if n not in experiments}
-            if evicted:
-                state["evicted"] = evicted
-            if smap is not None:
-                # compaction will drop any journaled shard_map adoption
-                # record at or below wal_seq — the snapshot must carry the
-                # adopted map or a restart falls back to its stale argv map
-                state["shard_map"] = smap
-            tmp = path + ".tmp"
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            with open(tmp, "w") as f:
-                json.dump(state, f)
-                # flush + fsync BEFORE the rename: os.replace orders the
-                # metadata, not the data blocks — on power loss the rename
-                # could land pointing at an unwritten file, destroying the
-                # previous good snapshot too
+                    try:
+                        seg_docs = self.inner.export_archive_segment(
+                            name, ref["seg"])
+                    except KeyError:
+                        # the experiment (or its archive) was deleted
+                        # mid-capture: drop the whole section — the delete
+                        # is in the WAL tail / re-requests via _snap_soon
+                        missing = True
+                        break
+                # one-time I/O per sealed segment, never repeated once
+                # durable (_seg_on_disk dedups across snapshots)
+                self._persist_segment(seg_dir, name, ref["seg"], seg_docs)
+            if missing:
+                continue
+            seg_entries = [{
+                "seg": ref["seg"],
+                "file": self._seg_on_disk[ref["seg"]],
+                "rows": ref["rows"],
+                "dead": ref["dead"],
+            } for ref in refs]
+            section = {"experiment": config, "docs": docs,
+                       "segments": seg_entries}
+            sections[name] = section
+            # cached only HERE, after every referenced segment file is
+            # durable — a reused section never points at a missing file
+            self._snap_sections[name] = (mut, section)
+        for stale in set(self._snap_sections) - set(sections):
+            del self._snap_sections[stale]
+        state = {
+            "version": 2,
+            "ts": time.time(),
+            "sections": sections,
+            "wal_seq": wal_seq,
+        }
+        self._snapshot_globals(state, sections)
+        self._snapshot_commit(path, state, wal, wal_seq)
+        self._gc_segments(seg_dir, sections)
+
+    # mtpu: holds(_snap_lock)
+    def _persist_segment(self, seg_dir: str, name: str, seg_id: str,
+                         docs: List[Dict[str, Any]]) -> str:
+        """Write one sealed segment's rows (dead included — the manifest's
+        ``dead`` list filters at restore, so revivals never force a
+        rewrite) crash-atomically, once per segment id ever."""
+        fname = seg_id + ".json"
+        if self._seg_on_disk.get(seg_id) == fname:
+            return fname
+        os.makedirs(seg_dir, exist_ok=True)
+        tmp = os.path.join(seg_dir, fname + ".tmp")
+        final = os.path.join(seg_dir, fname)
+        with open(tmp, "w") as f:
+            json.dump({"experiment": name, "seg": seg_id, "docs": docs}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        fsync_dir(final)
+        self._seg_on_disk[seg_id] = fname
+        if faults.fire("crash_segment_seal"):
+            # chaos: die with the segment file durable but no manifest
+            # referencing it — recovery must come up on the previous
+            # manifest + WAL, and the orphan file must be GC'd by a later
+            # snapshot, never loaded
+            os.kill(os.getpid(), _signal_mod.SIGKILL)
+        return fname
+
+    def _snapshot_globals(self, state: Dict[str, Any],
+                          resident: Dict[str, Any]) -> None:
+        """Capture the non-ledger globals every snapshot format carries."""
+        with self._sig_lock:
+            state["signals"] = [
+                {"experiment": e, "trial": t, "signal": s}
+                for (e, t), s in self._signals.items()
+            ]
+        with self._map_cv:
+            smap = self.shard_map
+        with self._evict_lock:
+            # compaction drops journaled evict records at or below
+            # wal_seq — the snapshot must carry the stubs or a restart
+            # forgets which experiments live in evict files. Stubs for
+            # experiments captured resident above are skipped (a
+            # non-memory backend keeps docs on disk through eviction).
+            evicted = {n: dict(s) for n, s in self._evicted.items()
+                       if n not in resident}
+        if evicted:
+            state["evicted"] = evicted
+        if smap is not None:
+            # compaction will drop any journaled shard_map adoption
+            # record at or below wal_seq — the snapshot must carry the
+            # adopted map or a restart falls back to its stale argv map
+            state["shard_map"] = smap
+
+    # mtpu: holds(_snap_lock)
+    def _snapshot_commit(self, path: str, state: Dict[str, Any],
+                         wal, wal_seq: int) -> None:
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            # flush + fsync BEFORE the rename: os.replace orders the
+            # metadata, not the data blocks — on power loss the rename
+            # could land pointing at an unwritten file, destroying the
+            # previous good snapshot too
+            f.flush()
+            if faults.fire("partial_snapshot"):
+                # chaos: die mid-snapshot — a truncated tmp on disk,
+                # the previous snapshot and the (un-compacted) WAL
+                # intact. Recovery must ignore the torn tmp entirely.
+                f.truncate(max(1, f.tell() // 2))
                 f.flush()
-                if faults.fire("partial_snapshot"):
-                    # chaos: die mid-snapshot — a truncated tmp on disk,
-                    # the previous snapshot and the (un-compacted) WAL
-                    # intact. Recovery must ignore the torn tmp entirely.
-                    f.truncate(max(1, f.tell() // 2))
-                    f.flush()
-                    os.fsync(f.fileno())
-                    os.kill(os.getpid(), _signal_mod.SIGKILL)
                 os.fsync(f.fileno())
-            os.replace(tmp, path)
-            fsync_dir(path)
-            if wal is not None:
-                # everything <= wal_seq is now durably in the snapshot;
-                # drop it so replay cost tracks one snapshot interval
+                os.kill(os.getpid(), _signal_mod.SIGKILL)
+            os.fsync(f.fileno())
+            if faults.fire("crash_manifest_commit"):
+                # chaos: die with the tmp manifest fully durable but the
+                # rename not yet issued — recovery must come up on the
+                # PREVIOUS manifest plus the (un-compacted) WAL; newly
+                # sealed segment files are unreferenced orphans until a
+                # post-recovery snapshot collects them
+                os.kill(os.getpid(), _signal_mod.SIGKILL)
+        os.replace(tmp, path)
+        fsync_dir(path)
+        if wal is not None:
+            # everything <= wal_seq is now durably in the snapshot;
+            # drop it so replay cost tracks one snapshot interval. The
+            # rewrite runs under the compaction fence so it can never
+            # interleave with handoff tail extraction — compact() ignores
+            # the calling thread's own fence, so this cannot self-deadlock.
+            with wal.compaction_fence():
                 wal.compact(wal_seq)
+
+    # mtpu: holds(_snap_lock)
+    def _gc_segments(self, seg_dir: str,
+                     sections: Dict[str, Dict[str, Any]]) -> None:
+        """Remove segment files the just-committed manifest does not
+        reference. Runs strictly AFTER the manifest is durable: until
+        then the old manifest may still need the old files."""
+        referenced = {entry["file"] for sec in sections.values()
+                      for entry in sec["segments"]}
+        try:
+            on_disk = os.listdir(seg_dir)
+        except OSError:
+            return
+        for fname in on_disk:
+            if fname in referenced:
+                continue
+            # deleted experiments' segments, pre-crash orphans from
+            # crash_segment_seal / crash_manifest_commit windows, and
+            # torn .tmp files all land here
+            try:
+                os.remove(os.path.join(seg_dir, fname))
+            except OSError:
+                pass
+        for seg_id, fname in list(self._seg_on_disk.items()):
+            if fname not in referenced:
+                del self._seg_on_disk[seg_id]
 
     def restore(self, path: str) -> Dict[str, Any]:
         """Merge a snapshot into the ledger; returns the loaded state dict
@@ -1048,9 +1245,17 @@ class CoordServer:
         and trials MISSING from the ledger are created — an existing
         trial's status is never touched, so restoring a stale snapshot
         over live (or WAL-replayed) state cannot roll anything back.
+
+        v2 (incremental) manifests are inflated to the v1 shape first:
+        mutable docs plus each referenced segment file's live rows, the
+        per-segment ``dead`` lists filtering revived rows out. The merge
+        below then re-registers docs through the normal validated path —
+        completed docs re-seal into the rebuilt archive as they arrive.
         """
         with open(path) as f:
             state = json.load(f)
+        if int(state.get("version", 1)) >= 2:
+            self._inflate_v2(path, state)
         with self._lock:
             existing = set(self.inner.list_experiments())
             for name, config in state["experiments"].items():
@@ -1081,6 +1286,35 @@ class CoordServer:
                         self._ring = RoutingTable(snap_map)
         log.info("restored %d experiments from %s", len(state["experiments"]), path)
         return state
+
+    def _inflate_v2(self, path: str, state: Dict[str, Any]) -> None:
+        """Expand a v2 manifest in place to the v1 shape ``restore``
+        merges: per-experiment config + full doc list. A missing or torn
+        segment file loses only that segment's rows — the rest of the
+        manifest still restores (and the WAL tail still replays)."""
+        seg_dir = path + ".segments"
+        experiments: Dict[str, Any] = {}
+        trials: Dict[str, Any] = {}
+        for name, sec in (state.get("sections") or {}).items():
+            experiments[name] = sec.get("experiment")
+            docs = list(sec.get("docs") or [])
+            for entry in sec.get("segments") or []:
+                fp = os.path.join(seg_dir, entry["file"])
+                try:
+                    with open(fp) as sf:
+                        seg_state = json.load(sf)
+                except (OSError, ValueError):
+                    log.error(
+                        "segment file %s unreadable; its rows are lost "
+                        "to this restore", fp)
+                    continue
+                dead = set(entry.get("dead") or ())
+                docs.extend(
+                    d for i, d in enumerate(seg_state.get("docs") or [])
+                    if i not in dead)
+            trials[name] = docs
+        state["experiments"] = experiments
+        state["trials"] = trials
 
     # -- lazy hydration / eviction (ISSUE 16) ------------------------------
     @property
@@ -2213,14 +2447,22 @@ class CoordServer:
                     self._coalescers.pop(a.get("name"), None)
                 # durability: restore() merges a stale snapshot's docs back
                 # in, which would RESURRECT the deleted experiment after a
-                # crash — so persist the post-delete state now. Outside the
-                # ledger locks: snapshot takes _snap_lock → exp locks
-                # (AB-BA with housekeeping otherwise).
+                # crash. With a WAL the delete record in the tail already
+                # replays over any stale snapshot, so the serving thread
+                # only REQUESTS a snapshot and the housekeeping loop pays
+                # for it — the request path stays flat. Without a WAL the
+                # snapshot is the only durability there is: take it inline.
+                # Outside the ledger locks either way: snapshot takes
+                # _snap_lock → exp locks (AB-BA with housekeeping
+                # otherwise).
                 if self.snapshot_path:
-                    try:
-                        self.snapshot(self.snapshot_path)
-                    except Exception:
-                        log.exception("post-delete snapshot failed")
+                    if self._wal is not None:
+                        self._snap_soon.set()
+                    else:
+                        try:
+                            self.snapshot(self.snapshot_path)
+                        except Exception:
+                            log.exception("post-delete snapshot failed")
             return reply
         # plain reads (get/count/load/list/heartbeat/ping): no server lock,
         # no caches — the backend's own locking is the only serialization

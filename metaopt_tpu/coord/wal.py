@@ -233,6 +233,11 @@ class WriteAheadLog:
         self._syncing = False
         self._failed = False  # fsync/write failed: journaling degraded
         self._fence = 0      # open compaction fences (hand-off tail ships)
+        #: per-thread share of ``_fence`` (thread id → open depth): lets
+        #: ``compact`` distinguish its OWN caller's fence (snapshot wraps
+        #: its compact in one to exclude concurrent extractions) from a
+        #: foreign hand-off's — waiting on your own fence would deadlock
+        self._fence_owners: Dict[int, int] = {}
         self._f: Optional[Any] = None
         self.batches = 0     # fsync batches written (amortization telemetry)
         self.records = 0
@@ -435,13 +440,31 @@ class WriteAheadLog:
                 self._cv.notify_all()
 
     def _fence_enter(self) -> None:
+        tid = threading.get_ident()
         with self._cv:
             self._fence += 1
+            self._fence_owners[tid] = self._fence_owners.get(tid, 0) + 1
 
     def _fence_exit(self) -> None:
+        tid = threading.get_ident()
         with self._cv:
             self._fence = max(0, self._fence - 1)
+            depth = self._fence_owners.get(tid, 0) - 1
+            if depth > 0:
+                self._fence_owners[tid] = depth
+            else:
+                self._fence_owners.pop(tid, None)
             self._cv.notify_all()
+
+    def _foreign_fences(self) -> int:
+        # mtpu: holds(_cv)
+        return self._fence - self._fence_owners.get(threading.get_ident(), 0)
+
+    def fence_held(self) -> bool:
+        """True while the CALLING thread holds an open compaction fence —
+        the assertion hook for paths required to run fenced."""
+        with self._cv:
+            return self._fence_owners.get(threading.get_ident(), 0) > 0
 
     # -- maintenance ------------------------------------------------------
     def compact(self, upto_seq: int) -> None:
@@ -457,8 +480,11 @@ class WriteAheadLog:
         while True:
             with self._cv:
                 # a hand-off fence holds compaction off entirely: the
-                # shipped tail must stay on disk until ownership commits
-                if self._syncing or self._fence > 0:
+                # shipped tail must stay on disk until ownership commits.
+                # Only FOREIGN fences count — the snapshot path compacts
+                # under its own fence (held against concurrent
+                # extractions), which must not block itself.
+                if self._syncing or self._foreign_fences() > 0:
                     self._cv.wait(timeout=1.0)
                     continue
                 self._syncing = True

@@ -61,6 +61,18 @@ selector walks the barriers in order):
   partial prefix harmlessly and the orchestrator's retried apply
   completes the move.
 
+Incremental-snapshot kinds (consumed in ``coord/server.py``; the
+columnar trial-archive manifest pipeline):
+
+- ``crash_segment_seal``: die right after a sealed archive segment's
+  file is durable under ``<snapshot>.segments/`` but before any
+  manifest references it — recovery restores from the previous
+  manifest + WAL; the orphan segment file must be GC'd by a later
+  snapshot, never loaded.
+- ``crash_manifest_commit``: die with the new manifest ``.tmp`` fully
+  fsynced but the atomic rename not yet issued — recovery comes back
+  on the PREVIOUS manifest plus the un-compacted WAL, bit-identically.
+
 Eviction kind (consumed in ``coord/server.py``; the lazy
 hydration/eviction plane of the multi-tenant service):
 

@@ -32,6 +32,10 @@ import uuid
 from abc import ABC, abstractmethod
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
+from metaopt_tpu.ledger.archive import (CompletedBatch, ExperimentArchive,
+                                        _id_key)
 from metaopt_tpu.ledger.trial import Trial
 from metaopt_tpu.utils.registry import Registry
 
@@ -188,14 +192,69 @@ class LedgerBackend(ABC):
 # ---------------------------------------------------------------------------
 
 
+class _CompletedLog:
+    """Append-only completion-order log of trial ids.
+
+    A plain list of id strings costs ~80 bytes per entry at 1M trials
+    (the 24-char str plus its slot); this stores the ids in a growable
+    fixed-width ``S24`` byte array (~24 bytes/entry) and materializes
+    strings lazily at iteration. Ids the fixed shape can't round-trip
+    (see :func:`metaopt_tpu.ledger.archive._id_key`) go to a side dict.
+    Same contract as the list it replaces: ``len`` is the cursor space,
+    entries are immutable once appended.
+    """
+
+    __slots__ = ("_buf", "_len", "_odd")
+
+    def __init__(self) -> None:
+        self._buf = np.empty(64, dtype="S24")
+        self._len = 0
+        self._odd: Dict[int, str] = {}
+
+    def __len__(self) -> int:
+        return self._len
+
+    def append(self, tid: str) -> None:
+        if self._len == len(self._buf):
+            grown = np.empty(len(self._buf) * 2, dtype="S24")
+            grown[:self._len] = self._buf
+            self._buf = grown
+        key = _id_key(tid)
+        if key is None:
+            self._odd[self._len] = tid
+            key = b""
+        self._buf[self._len] = key
+        self._len += 1
+
+    def iter_from(self, start: int):
+        for i in range(start, self._len):
+            odd = self._odd.get(i)
+            yield odd if odd is not None else self._buf[i].decode()
+
+
 @ledger_registry.register("memory")
 class MemoryLedger(LedgerBackend):
     """Dict + lock. The EphemeralDB equivalent for tests/single-process runs."""
 
-    def __init__(self, **_: Any) -> None:
+    def __init__(self, archive_completed: bool = True,
+                 archive_segment_rows: int = 4096, **_: Any) -> None:
         self._lock = threading.RLock()
         self._experiments: Dict[str, Dict[str, Any]] = {}
+        #: RESIDENT trials only — an id lives in exactly one of this table
+        #: or the experiment's archive, never both. Completed trials move
+        #: to the archive (below); everything mutable stays here.
         self._trials: Dict[str, Dict[str, Trial]] = {}
+        #: columnar archive per experiment (ledger/archive.py): completed
+        #: trials are terminal, so they seal into structure-of-arrays
+        #: segments instead of sitting as resident Python objects — flat
+        #: RSS at 1M+ trials. When it is on, the archive's own id index
+        #: doubles as the "completed" status index (_move skips the set)
+        #: and the completed log stores fixed-width bytes, not id strings
+        #: — the per-trial Python-object footprint is what the archive
+        #: exists to eliminate.
+        self._archive_completed = bool(archive_completed)
+        self._segment_rows = int(archive_segment_rows)
+        self._archives: Dict[str, ExperimentArchive] = {}
         #: per-experiment status → trial-id set. reserve/count/fetch were
         #: O(all trials) scans; at 10k trials the in-RAM backend measured
         #: 7× SLOWER than the on-disk C++ engine (r4 sweep_scale), and
@@ -211,7 +270,7 @@ class MemoryLedger(LedgerBackend):
         self._new_heap: Dict[str, List[Any]] = {}
         #: per-experiment completion order (trial ids, appended on every
         #: transition INTO completed) — backs fetch_completed_since
-        self._completed_log: Dict[str, List[str]] = {}
+        self._completed_log: Dict[str, _CompletedLog] = {}
         #: instance identity baked into cursors: a cursor minted against a
         #: PREVIOUS instance (e.g. a restarted coordinator that restored a
         #: snapshot in a different order) must trigger a full refetch, or
@@ -233,9 +292,10 @@ class MemoryLedger(LedgerBackend):
             # a fresh experiment must not inherit ghost trials left by a
             # register that raced a delete_experiment of the same name
             self._trials[name] = {}
+            self._archives.pop(name, None)
             self._status_ids[name] = {}
             self._new_heap[name] = []
-            self._completed_log[name] = []
+            self._completed_log[name] = _CompletedLog()
             self._exp_gen[name] = next(_MEM_EPOCHS)
 
     def load_experiment(self, name: str) -> Optional[Dict[str, Any]]:
@@ -258,6 +318,7 @@ class MemoryLedger(LedgerBackend):
             existed = name in self._experiments
             self._experiments.pop(name, None)
             self._trials.pop(name, None)
+            self._archives.pop(name, None)
             self._status_ids.pop(name, None)
             self._new_heap.pop(name, None)
             self._completed_log.pop(name, None)
@@ -269,12 +330,29 @@ class MemoryLedger(LedgerBackend):
         return self._status_ids.setdefault(experiment, {})
 
     # mtpu: holds(_lock)
+    def _arch(self, experiment: str) -> ExperimentArchive:
+        """Write-path accessor (creates on first completed trial); read
+        paths use ``self._archives.get`` so they never resurrect entries
+        for deleted experiment names."""
+        arch = self._archives.get(experiment)
+        if arch is None:
+            arch = ExperimentArchive(experiment, self._segment_rows)
+            self._archives[experiment] = arch
+        return arch
+
+    # mtpu: holds(_lock)
     def _move(self, experiment: str, tid: str, old: Optional[str],
               new: str) -> None:
         idx = self._index(experiment)
         if old is not None and old != new:
             idx.get(old, set()).discard(tid)
-        idx.setdefault(new, set()).add(tid)
+        if new == "completed" and self._archive_completed:
+            # the archive's own id index IS the completed index — a
+            # per-id set entry here would duplicate it (~33 bytes/trial
+            # at 1M); count/fetch consult the archive instead
+            pass
+        else:
+            idx.setdefault(new, set()).add(tid)
         if new == "new":
             stored = self._trials.get(experiment, {}).get(tid)
             heapq.heappush(
@@ -285,13 +363,19 @@ class MemoryLedger(LedgerBackend):
     def register(self, trial: Trial) -> None:
         with self._lock:
             exp = self._trials.setdefault(trial.experiment, {})
-            if trial.id in exp:
+            arch = self._archives.get(trial.experiment)
+            if trial.id in exp or (arch is not None
+                                   and arch.contains(trial.id)):
                 raise DuplicateTrialError(trial.id)
-            exp[trial.id] = trial.clone()
+            if trial.status == "completed" and self._archive_completed:
+                # db load / replay of finished trials: straight to archive
+                self._arch(trial.experiment).append(trial.to_dict())
+            else:
+                exp[trial.id] = trial.clone()
             self._move(trial.experiment, trial.id, None, trial.status)
             if trial.status == "completed":  # db load of finished trials
                 self._completed_log.setdefault(
-                    trial.experiment, []
+                    trial.experiment, _CompletedLog()
                 ).append(trial.id)
 
     def reserve(self, experiment: str, worker: str) -> Optional[Trial]:
@@ -324,18 +408,56 @@ class MemoryLedger(LedgerBackend):
             exp = self._trials.get(trial.experiment, {})
             stored = exp.get(trial.id)
             if stored is None:
-                return False
+                return self._update_archived(
+                    trial, expected_status, expected_worker
+                )
             if expected_status is not None and stored.status != expected_status:
                 return False
             if expected_worker is not None and stored.worker != expected_worker:
                 return False
             if trial.status == "completed" and stored.status != "completed":
                 self._completed_log.setdefault(
-                    trial.experiment, []
+                    trial.experiment, _CompletedLog()
                 ).append(trial.id)
-            exp[trial.id] = trial.clone()
+            if trial.status == "completed" and self._archive_completed:
+                # terminal: seal into the columnar archive, drop the
+                # resident object (the whole point — flat RSS per trial)
+                del exp[trial.id]
+                self._arch(trial.experiment).append(trial.to_dict())
+            else:
+                exp[trial.id] = trial.clone()
             self._move(trial.experiment, trial.id, stored.status, trial.status)
             return True
+
+    # mtpu: holds(_lock)
+    def _update_archived(
+        self,
+        trial: Trial,
+        expected_status: Optional[str],
+        expected_worker: Optional[str],
+    ) -> bool:
+        """``update_trial`` against an archived (completed) document: CAS
+        checks run against the archive's columns; a write that keeps the
+        trial completed re-archives it, anything else (``db set
+        status=new`` revival, replay of an older state) pulls it back to
+        the resident table."""
+        arch = self._archives.get(trial.experiment)
+        if arch is None or not arch.contains(trial.id):
+            return False
+        if expected_status is not None and expected_status != "completed":
+            return False
+        if (expected_worker is not None
+                and arch.worker_of(trial.id) != expected_worker):
+            return False
+        if trial.status == "completed":
+            # stays terminal: no status move, no completed-log append
+            arch.replace(trial.id, trial.to_dict())
+            return True
+        arch.discard(trial.id)
+        self._trials.setdefault(trial.experiment, {})[trial.id] = \
+            trial.clone()
+        self._move(trial.experiment, trial.id, "completed", trial.status)
+        return True
 
     def heartbeat(self, experiment: str, trial_id: str, worker: str) -> bool:
         with self._lock:
@@ -348,20 +470,40 @@ class MemoryLedger(LedgerBackend):
     def get(self, experiment: str, trial_id: str) -> Optional[Trial]:
         with self._lock:
             t = self._trials.get(experiment, {}).get(trial_id)
-            return t.clone() if t else None
+            if t is not None:
+                return t.clone()
+            arch = self._archives.get(experiment)
+            return arch.get_trial(trial_id) if arch is not None else None
 
     def fetch(self, experiment: str, status=None) -> List[Trial]:
         statuses = (status,) if isinstance(status, str) else status
         with self._lock:
             exp = self._trials.get(experiment, {})
+            arch = self._archives.get(experiment)
             if statuses is None:
-                picked = exp.values()
+                out = [t.clone() for t in exp.values()]
+                if arch is not None:
+                    out.extend(Trial.from_dict_trusted(d)
+                               for d in arch.iter_docs())
             else:  # index: touch only matching trials, not the whole table
                 idx = self._status_ids.get(experiment, {})
                 ids = set().union(*(idx.get(s, set()) for s in statuses)) \
                     if statuses else set()
-                picked = (exp[i] for i in ids if i in exp)
-            out = [t.clone() for t in picked]
+                out = []
+                for i in ids:
+                    t = exp.get(i)
+                    if t is not None:
+                        out.append(t.clone())
+                    elif arch is not None:
+                        at = arch.get_trial(i)
+                        if at is not None:
+                            out.append(at)
+                if ("completed" in statuses and arch is not None
+                        and self._archive_completed):
+                    # archived ids have no index entries (_move) — the
+                    # archive enumerates them itself
+                    out.extend(Trial.from_dict_trusted(d)
+                               for d in arch.iter_docs())
             out.sort(key=lambda t: (t.submit_time or 0, t.id))
             return out
 
@@ -371,40 +513,119 @@ class MemoryLedger(LedgerBackend):
         statuses = (status,) if isinstance(status, str) else status
         with self._lock:
             if statuses is None:
-                return len(self._trials.get(experiment, {}))
+                arch = self._archives.get(experiment)
+                return (len(self._trials.get(experiment, {}))
+                        + (len(arch) if arch is not None else 0))
             idx = self._status_ids.get(experiment, {})
-            return sum(len(idx.get(s, ())) for s in statuses)
+            total = sum(len(idx.get(s, ())) for s in statuses)
+            if "completed" in statuses and self._archive_completed:
+                arch = self._archives.get(experiment)
+                if arch is not None:
+                    total += len(arch)
+            return total
 
     def export_docs(self, experiment: str) -> List[Dict[str, Any]]:
         """Raw trial documents, one conversion each — the snapshot path.
 
         ``fetch`` deep-copies through from_dict(to_dict(...)) and the
         snapshot then calls to_dict again: three conversions per trial
-        under the coordinator's global lock. This does one.
+        under the coordinator's global lock. This does one. Archived
+        trials decode from their columns — evict/hand-off capture stays
+        bit-identical to the all-resident path.
         """
         with self._lock:
-            return [t.to_dict() for t in
-                    self._trials.get(experiment, {}).values()]
+            out = [t.to_dict() for t in
+                   self._trials.get(experiment, {}).values()]
+            arch = self._archives.get(experiment)
+            if arch is not None:
+                out.extend(arch.iter_docs())
+            return out
+
+    def export_mutable_docs(self, experiment: str) -> List[Dict[str, Any]]:
+        """Docs NOT covered by sealed segments: resident trials plus the
+        archive's unsealed head — the part an incremental snapshot must
+        reserialize every time (everything else is referenced by segment
+        id; see :meth:`archive_segment_refs`)."""
+        with self._lock:
+            out = [t.to_dict() for t in
+                   self._trials.get(experiment, {}).values()]
+            arch = self._archives.get(experiment)
+            if arch is not None:
+                out.extend(arch.head_docs())
+            return out
+
+    def archive_segment_refs(self, experiment: str) -> List[Dict[str, Any]]:
+        """Sealed-segment manifest entries (id, rows, dead list) for the
+        incremental snapshot; empty when nothing sealed."""
+        with self._lock:
+            arch = self._archives.get(experiment)
+            return arch.segment_refs() if arch is not None else []
+
+    def export_archive_segment(
+        self, experiment: str, seg_id: str
+    ) -> List[Dict[str, Any]]:
+        """All rows of one sealed segment (including dead ones — the
+        manifest's dead list filters at restore). Immutable: written to
+        its snapshot file exactly once."""
+        with self._lock:
+            arch = self._archives.get(experiment)
+            if arch is None:
+                raise KeyError(f"no archive for experiment {experiment!r}")
+            return arch.export_segment_docs(seg_id)
+
+    def seal_archive(self, experiment: str) -> None:
+        """Force-seal the archive head (tests; pre-handoff determinism)."""
+        with self._lock:
+            arch = self._archives.get(experiment)
+            if arch is not None:
+                arch.seal()
+
+    def archive_stats(self, experiment: str) -> Dict[str, Any]:
+        with self._lock:
+            arch = self._archives.get(experiment)
+            return arch.stats() if arch is not None else {
+                "live": 0, "segments": 0, "sealed_rows": 0,
+                "dead_rows": 0, "head_rows": 0, "overflow_rows": 0,
+            }
 
     def fetch_completed_since(self, experiment: str, cursor=None):
         with self._lock:
-            log_ = self._completed_log.get(experiment, [])
+            log_ = self._completed_log.get(experiment)
+            log_len = len(log_) if log_ is not None else 0
             gen = self._exp_gen.get(experiment, 0)
             start = 0
             if (cursor and cursor[0] == self._epoch
                     and int(cursor[1]) == gen
-                    and int(cursor[2]) <= len(log_)):
+                    and int(cursor[2]) <= log_len):
                 start = int(cursor[2])
             exp = self._trials.get(experiment, {})
-            out = [
-                exp[tid].clone()
-                for tid in log_[start:]
-                # a revived (completed→new) trial stays in the log; skip
-                # it until it re-completes and re-appends
-                if tid in exp and exp[tid].status == "completed"
-            ]
-            out.sort(key=lambda t: (t.submit_time or 0, t.id))
-            return out, [self._epoch, gen, len(log_)]
+            arch = self._archives.get(experiment)
+            # entries materialize lazily (CompletedBatch): archived rows
+            # travel as (segment, row) refs so the observe path can batch
+            # straight off the columns without a per-trial dict round-trip
+            keyed = []
+            for tid in (log_.iter_from(start) if log_ is not None else ()):
+                t = exp.get(tid)
+                if t is not None:
+                    # a revived (completed→new) trial stays in the log;
+                    # skip it until it re-completes and re-appends
+                    if t.status == "completed":
+                        keyed.append(((t.submit_time or 0, tid),
+                                      ("t", t.clone())))
+                    continue
+                if arch is None:
+                    continue
+                e = arch.entry(tid)
+                if e is None:
+                    continue
+                if e[0] == "d":
+                    st = e[1].get("submit_time")
+                else:
+                    st = e[1].submit_time_of(e[2])
+                keyed.append(((st or 0, tid), e))
+            keyed.sort(key=lambda p: p[0])
+            batch = CompletedBatch([e for _, e in keyed])
+            return batch, [self._epoch, gen, log_len]
 
 
 # ---------------------------------------------------------------------------

@@ -189,7 +189,11 @@ class NativeFileLedger(FileLedger):
         doc["heartbeat"] = hb if hb > 0 else None
         if env["status"] == "reserved":
             doc["worker"] = env["worker"] or None
-        return Trial.from_dict(doc)
+        # trusted: the payload is this backend's own ls_put serialization
+        # of a to_dict — skipping __post_init__ avoids re-jsonable'ing
+        # params on EVERY envelope decode (fetch of 10k trials pays it
+        # 10k times) and cannot re-mint ids or mis-validate
+        return Trial.from_dict_trusted(doc)
 
     # -- trial ops on the engine ------------------------------------------
     def register(self, trial: Trial) -> None:

@@ -125,6 +125,47 @@ class UnitCube:
             return np.zeros((0, self.n_dims))
         return np.stack([self.transform(p) for p in points])
 
+    def transform_columns(
+        self, cols: Mapping[str, Sequence[Any]], n: int
+    ) -> np.ndarray:
+        """Column-major forward transform: per-param value columns (one
+        sequence of ``n`` raw values each, the shape ``CompletedBatch.
+        columns()`` hands over) → an ``(n, n_dims)`` matrix bit-identical
+        row-for-row to ``transform(point)``.
+
+        Uniform reals and integers vectorize over the column — the
+        elementwise IEEE ops match the scalar path exactly. Loguniform
+        and normal go through the SAME per-element ``math.log`` / scalar
+        ``ndtr`` calls as ``_fwd_one`` (a vectorized np.log can differ in
+        the last ulp, and the surrogate replay contract is bit-identity
+        with the per-point stream). Categorical and array-shaped columns
+        reuse ``_fwd_one`` per element: option equality is arbitrary-
+        object equality, nothing to vectorize.
+        """
+        out = np.empty((n, self.n_dims), dtype=np.float64)
+        for j, (d, idx) in enumerate(self.columns):
+            col = cols[d.name]
+            if idx is not None:
+                for i in range(n):
+                    arr = np.asarray(
+                        col[i],
+                        dtype=object if isinstance(d, Categorical) else None,
+                    )
+                    out[i, j] = self._fwd_one(d, arr[idx])
+                continue
+            if isinstance(d, Real) and d.prior_name == "uniform":
+                low, high = d.interval()
+                vals = np.asarray([float(v) for v in col], dtype=np.float64)
+                np.clip((vals - low) / (high - low), 0.0, 1.0, out=out[:, j])
+            elif isinstance(d, Integer):
+                low, high = d.interval()
+                vals = np.asarray([float(v) for v in col], dtype=np.float64)
+                out[:, j] = (vals - (low - 0.5)) / ((high + 0.5) - (low - 0.5))
+            else:
+                for i in range(n):
+                    out[i, j] = self._fwd_one(d, col[i])
+        return out
+
     # -- backward ---------------------------------------------------------
     def _bwd_one(self, dim, u: float):
         u = min(1.0 - _EPS, max(_EPS, float(u)))

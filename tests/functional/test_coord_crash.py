@@ -44,8 +44,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 _SERVER_SRC = """
 import sys
 from metaopt_tpu.coord.server import CoordServer, serve_forever
+kw = {}
+if len(sys.argv) > 3 and int(sys.argv[3]):
+    kw["archive_segment_rows"] = int(sys.argv[3])
 serve_forever(CoordServer(
     port=int(sys.argv[1]), snapshot_path=sys.argv[2], stale_timeout_s=60.0,
+    **kw,
 ))
 """
 
@@ -59,9 +63,10 @@ def _free_port():
 class _Supervisor:
     """Restart-on-exit babysitter for the subprocess coordinator."""
 
-    def __init__(self, snap, port, faults=""):
+    def __init__(self, snap, port, faults="", segment_rows=0):
         self.snap, self.port = snap, port
         self.faults = faults  # armed only for the FIRST incarnation
+        self.segment_rows = segment_rows
         self.recovery_times = []
         self._stop = threading.Event()
         self._procs = []
@@ -73,7 +78,8 @@ class _Supervisor:
         env = dict(os.environ, JAX_PLATFORMS="cpu", METAOPT_TPU_FAULTS=faults)
         t0 = time.monotonic()
         proc = subprocess.Popen(
-            [sys.executable, "-c", _SERVER_SRC, str(self.port), self.snap],
+            [sys.executable, "-c", _SERVER_SRC, str(self.port), self.snap,
+             str(self.segment_rows)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO, env=env,
         )
@@ -196,6 +202,68 @@ def test_kill9_zero_acked_write_loss(tmp_path, faults):
         if reserved_id is not None:
             # the fused cycle's reserve leg survived too (reply was acked)
             assert vc.count("chaos", status="reserved") == 1
+
+
+@pytest.mark.parametrize(
+    "faults",
+    [
+        # die at the first / second segment-file barrier (after the file
+        # is durable, before any manifest references it), and at the
+        # manifest barrier (tmp fsynced, rename not issued)
+        "crash_segment_seal:1@0",
+        "crash_segment_seal:1@1",
+        "crash_manifest_commit:1@0",
+    ],
+)
+def test_kill9_archive_snapshot_barriers(tmp_path, faults):
+    """kill -9 at the incremental-snapshot barriers: every acked
+    completion (params AND objective) must come back bit-identically from
+    whatever mix of previous-manifest, orphaned-segment and WAL-tail
+    state the crash left behind."""
+    snap = str(tmp_path / "snap.json")
+    port = _free_port()
+    sup = _Supervisor(snap, port, faults=faults, segment_rows=8)
+    client = CoordLedgerClient(host="127.0.0.1", port=port,
+                               reconnect_window_s=60.0)
+    expected = {}
+
+    def complete(i):
+        t = Trial(params={"x": float(i)}, experiment="chaos")
+        client.register(t)
+        got = client.reserve("chaos", "w0")
+        assert got is not None
+        got.attach_results(
+            [{"name": "objective", "type": "objective", "value": float(i)}]
+        )
+        got.transition("completed")
+        assert client.update_trial(got, expected_status="reserved")
+        expected[got.id] = float(i)
+
+    try:
+        client.create_experiment({
+            "name": "chaos", "space": {"x": "uniform(0, 100)"},
+            "algorithm": {"random": {"seed": 0}}, "max_trials": 1000,
+        })
+        for i in range(20):   # 2 sealed segments + a 4-row mutable head
+            complete(i)
+        # the armed fault fires inside this snapshot; the client's retry
+        # lands on the restarted server, which re-runs it disarmed
+        assert client._call("snapshot", path=snap) == snap
+        for i in range(20, 28):  # acked writes AFTER the crash window
+            complete(i)
+        assert sup.crashes() == 1, "the armed fault never fired"
+    finally:
+        sup.stop()
+        client = None
+
+    assert all(rt < 30.0 for rt in sup.recovery_times[1:])
+    with CoordServer(snapshot_path=snap) as verify:
+        vc = CoordLedgerClient(host=verify.address[0], port=verify.address[1])
+        docs = vc.fetch("chaos")
+        ids = [t.id for t in docs]
+        assert len(ids) == len(set(ids)), "duplicate registrations"
+        got = {t.id: t.objective for t in docs if t.status == "completed"}
+        assert got == expected, "acked completion lost or corrupted"
 
 
 def test_worker_cycle_retry_exactly_once_through_crash(tmp_path):

@@ -33,6 +33,26 @@ def _trial(x, exp="exp"):
     return Trial(params={"x": x}, experiment=exp)
 
 
+def _snap_experiments(state):
+    """Experiment names in a snapshot, v1 (full dump) or v2 (incremental
+    manifest with per-experiment sections)."""
+    if int(state.get("version", 1)) >= 2:
+        return set(state.get("sections", {}))
+    return set(state.get("experiments", {}))
+
+
+def _snap_trial_count(state, exp):
+    """Live trial-doc count for ``exp`` — v2 counts mutable docs plus the
+    manifest's sealed-segment rows net of dead ones."""
+    if int(state.get("version", 1)) >= 2:
+        sec = state.get("sections", {}).get(exp, {})
+        return len(sec.get("docs", [])) + sum(
+            ref["rows"] - len(ref.get("dead", []))
+            for ref in sec.get("segments", [])
+        )
+    return len(state.get("trials", {}).get(exp, []))
+
+
 class TestSnapshotResume:
     def test_roundtrip_preserves_experiments_trials_signals(self, tmp_path):
         snap = str(tmp_path / "snap.json")
@@ -86,7 +106,7 @@ class TestSnapshotResume:
         path = str(tmp_path / "manual.json")
         assert c.snapshot(path) == path
         state = json.load(open(path))
-        assert "exp" in state["experiments"]
+        assert "exp" in _snap_experiments(state)
 
 
 class TestPacemaker:
@@ -246,7 +266,7 @@ class TestConcurrency:
         for t in threads:
             t.join(timeout=10)
         state = json.load(open(snap))  # must parse — no interleaved writes
-        assert len(state["trials"]["exp"]) == 20
+        assert _snap_trial_count(state, "exp") == 20
 
 
 class TestPodGlue:
@@ -993,7 +1013,7 @@ class TestWALDurability:
         # recovery physically truncated the torn tail, then compacted the
         # replayed prefix into the post-recovery snapshot
         assert os.path.getsize(wal) == 0
-        assert json.load(open(snap))["experiments"]
+        assert _snap_experiments(json.load(open(snap)))
 
     def test_recovery_refreshes_reserved_heartbeats(self, tmp_path):
         snap = str(tmp_path / "snap.json")

@@ -1,0 +1,473 @@
+"""Columnar completed-trial archive: bit-identity is the contract.
+
+The archive (ledger/archive.py) stores terminal trials structure-of-arrays
+instead of as resident Python objects; everything here checks the ONE
+invariant that makes that safe: a trial that round-trips through the
+columns serializes byte-for-byte like the resident original — and any doc
+the columns cannot represent exactly drops whole into the per-row
+overflow rather than being approximated. On top of that: revivals
+(completed → new) are liveness flips that never resurface stale rows, and
+``fetch_completed_since`` cursors keep meaning the same thing across
+segment sealing and WAL compaction.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from metaopt_tpu.ledger.archive import (
+    CompletedBatch,
+    ExperimentArchive,
+    _id_key,
+)
+from metaopt_tpu.ledger.backends import MemoryLedger
+from metaopt_tpu.ledger.trial import Trial
+
+
+def _seed(ledger, name="arc"):
+    ledger.create_experiment({
+        "name": name, "space": {"x": "uniform(0, 1)"},
+        "algorithm": {"random": {}}, "max_trials": 10_000, "version": 1,
+    })
+
+
+def _complete(ledger, name, i, params=None, results=None, mutate=None):
+    t = Trial(params=params or {"x": float(i)}, experiment=name)
+    ledger.register(t)
+    got = ledger.reserve(name, f"w{i % 3}")
+    assert got is not None
+    got.attach_results(results or [
+        {"name": "objective", "type": "objective", "value": float(i)}
+    ])
+    got.transition("completed")
+    if mutate:
+        mutate(got)
+    assert ledger.update_trial(got, expected_status="reserved")
+    return got
+
+
+class TestBitIdenticalMaterialization:
+    def test_sealed_rows_serialize_identically(self):
+        """to_dict of a trial fetched THROUGH the columns == to_dict of
+        the trial the worker completed — key order included (dict
+        equality in CPython is order-blind; compare the JSON too)."""
+        import json
+
+        ledger = MemoryLedger(archive_segment_rows=4)
+        _seed(ledger)
+        originals = {}
+        for i in range(11):  # 2 sealed segments + a 3-row head
+            got = _complete(ledger, "arc", i)
+            originals[got.id] = got.to_dict()
+        stats = ledger.archive_stats("arc")
+        assert stats["segments"] == 2 and stats["head_rows"] == 3
+        for tid, doc in originals.items():
+            back = ledger.get("arc", tid)
+            assert back.to_dict() == doc
+            assert json.dumps(back.to_dict()) == json.dumps(doc)
+        # and the bulk read path agrees with the point read
+        fetched = {t.id: t.to_dict()
+                   for t in ledger.fetch("arc", "completed")}
+        assert fetched == originals
+
+    @pytest.mark.parametrize("case", [
+        "multiobjective", "resources", "parent", "nan", "int_objective",
+    ])
+    def test_nonconforming_rows_overflow_not_approximate(self, case):
+        """Docs the columns cannot reproduce exactly must come back via
+        the per-row overflow — bit-identical, never coerced."""
+        ledger = MemoryLedger(archive_segment_rows=2)
+        _seed(ledger)
+
+        def mutate(t):
+            if case == "multiobjective":
+                t.attach_results(
+                    [{"name": "aux", "type": "statistic", "value": 3.5}]
+                )
+            elif case == "resources":
+                t.resources = {"tpu": 8}
+            elif case == "parent":
+                t.parent = "feedfeedfeed"
+            elif case == "nan":
+                t.results[0].value = math.nan
+
+        results = None
+        if case == "int_objective":
+            # int is a different TYPE than float even when == — a float64
+            # column would silently promote it
+            results = [{"name": "objective", "type": "objective", "value": 7}]
+
+        odd = _complete(ledger, "arc", 0, results=results,
+                        mutate=None if case == "int_objective" else mutate)
+        _complete(ledger, "arc", 1)  # fills the segment → seals both rows
+        assert ledger.archive_stats("arc")["segments"] == 1
+        assert ledger.archive_stats("arc")["overflow_rows"] >= 1
+        back = ledger.get("arc", odd.id).to_dict()
+        want = odd.to_dict()
+        if case == "nan":
+            # NaN != NaN: compare the one field specially, rest exactly
+            assert math.isnan(back["results"][0].pop("value"))
+            assert math.isnan(want["results"][0].pop("value"))
+        assert back == want
+
+    def test_mixed_param_types_column_dtypes(self):
+        """float params → f8 column, int params → i8, strings → object
+        list; every decode still compares equal to its source."""
+        arch = ExperimentArchive("arc", segment_rows=100)
+        docs = []
+        for i in range(6):
+            t = Trial(params={"lr": i / 7.0, "layers": i, "opt": f"adam{i}"},
+                      experiment="arc")
+            t.transition("reserved")
+            t.attach_results(
+                [{"name": "objective", "type": "objective", "value": i / 3.0}]
+            )
+            t.transition("completed")
+            docs.append(t.to_dict())
+            arch.append(t.to_dict())
+        arch.seal()
+        seg = arch._segments[0]
+        assert seg.pcols["lr"].dtype == np.float64
+        assert seg.pcols["layers"].dtype == np.int64
+        assert isinstance(seg.pcols["opt"], list)
+        assert not seg.overflow
+        for row, d in enumerate(docs):
+            assert seg.decode(row) == d
+
+    def test_clone_on_read(self):
+        """Materialized trials are fresh objects — mutating one must not
+        leak back into the archive."""
+        ledger = MemoryLedger(archive_segment_rows=2)
+        _seed(ledger)
+        got = _complete(ledger, "arc", 0)
+        a = ledger.get("arc", got.id)
+        a.params["x"] = 999.0
+        a.results[0].value = -1.0
+        b = ledger.get("arc", got.id)
+        assert b.params["x"] == 0.0 and b.objective == 0.0
+
+
+class TestRevival:
+    def test_completed_to_new_returns_resident(self):
+        """db-set style revival: the archived row dies, the trial comes
+        back mutable, and the id never appears twice in a fetch."""
+        ledger = MemoryLedger(archive_segment_rows=2)
+        _seed(ledger)
+        got = _complete(ledger, "arc", 0)
+        _complete(ledger, "arc", 1)  # seals the segment containing row 0
+        assert ledger.archive_stats("arc")["segments"] == 1
+
+        revived = ledger.get("arc", got.id)
+        revived.status = "new"
+        revived.worker = None
+        revived.results = []
+        assert ledger.update_trial(revived, expected_status="completed")
+
+        stats = ledger.archive_stats("arc")
+        assert stats["dead_rows"] == 1 and stats["live"] == 1
+        assert ledger.count("arc", "completed") == 1
+        assert ledger.count("arc", "new") == 1
+        ids = [t.id for t in ledger.fetch("arc")]
+        assert sorted(ids) == sorted(set(ids))
+        assert ledger.get("arc", got.id).status == "new"
+
+    def test_recompletion_appends_fresh_row(self):
+        ledger = MemoryLedger(archive_segment_rows=2)
+        _seed(ledger)
+        got = _complete(ledger, "arc", 0)
+        _complete(ledger, "arc", 1)
+        revived = ledger.get("arc", got.id)
+        revived.status = "new"
+        revived.worker = None
+        revived.results = []
+        assert ledger.update_trial(revived, expected_status="completed")
+        # run it again to a DIFFERENT objective
+        again = ledger.reserve("arc", "w9")
+        assert again.id == got.id
+        again.attach_results(
+            [{"name": "objective", "type": "objective", "value": 42.0}]
+        )
+        again.transition("completed")
+        assert ledger.update_trial(again, expected_status="reserved")
+        back = ledger.get("arc", got.id)
+        assert back.status == "completed" and back.objective == 42.0
+        # the old sealed row stays dead; liveness lives on the new row
+        stats = ledger.archive_stats("arc")
+        assert stats["dead_rows"] == 1
+        assert ledger.count("arc", "completed") == 2
+
+    def test_cas_against_archived_rows(self):
+        ledger = MemoryLedger(archive_segment_rows=2)
+        _seed(ledger)
+        got = _complete(ledger, "arc", 0)
+        stale = ledger.get("arc", got.id)
+        stale.status = "new"
+        # wrong expected_status: the CAS must refuse
+        assert not ledger.update_trial(stale, expected_status="reserved")
+        # wrong expected_worker likewise
+        assert not ledger.update_trial(
+            stale, expected_status="completed", expected_worker="not-me"
+        )
+        assert ledger.get("arc", got.id).status == "completed"
+
+
+class TestCursorsAcrossSealing:
+    def test_cursor_survives_segment_seal(self):
+        """A cursor minted while its delta sat in the head must read the
+        SAME delta after those rows seal into a segment."""
+        ledger = MemoryLedger(archive_segment_rows=100)
+        _seed(ledger)
+        for i in range(3):
+            _complete(ledger, "arc", i)
+        _, cur = ledger.fetch_completed_since("arc", None)
+        expected = {}
+        for i in range(3, 8):
+            t = _complete(ledger, "arc", i)
+            expected[t.id] = t.to_dict()
+        ledger.seal_archive("arc")  # the delta is now columnar
+        assert ledger.archive_stats("arc")["segments"] == 1
+        batch, cur2 = ledger.fetch_completed_since("arc", cur)
+        assert {t.id: t.to_dict() for t in batch} == expected
+        again, _ = ledger.fetch_completed_since("arc", cur2)
+        assert len(again) == 0
+
+    def test_columns_match_materialization(self):
+        """The observe fast path reads raw columns; ids/objectives must
+        agree with per-trial materialization, in the same order."""
+        ledger = MemoryLedger(archive_segment_rows=4)
+        _seed(ledger)
+        for i in range(10):
+            _complete(ledger, "arc", i)
+        batch, _ = ledger.fetch_completed_since("arc", None)
+        cols = batch.columns()
+        assert cols is not None
+        ids, pcols, y = cols
+        trials = list(batch)
+        assert ids == [t.id for t in trials]
+        assert [float(v) for v in y] == [t.objective for t in trials]
+        assert [float(v) for v in pcols["x"]] == \
+            [t.params["x"] for t in trials]
+
+    def test_columns_all_or_nothing_on_overflow(self):
+        """One overflow row anywhere → columns() is None and the caller
+        falls back to per-trial observe (order would skew otherwise)."""
+        ledger = MemoryLedger(archive_segment_rows=3)
+        _seed(ledger)
+        _complete(ledger, "arc", 0)
+
+        def mutate(t):
+            t.resources = {"tpu": 1}
+
+        _complete(ledger, "arc", 1, mutate=mutate)
+        _complete(ledger, "arc", 2)
+        assert ledger.archive_stats("arc")["overflow_rows"] == 1
+        batch, _ = ledger.fetch_completed_since("arc", None)
+        assert batch.columns() is None
+        assert len(list(batch)) == 3  # materialization still serves all
+
+    def test_revived_trial_skipped_until_recompleted(self):
+        """A revived id stays in the completed log; the batch must skip
+        it while it is non-completed (no ghost observations)."""
+        ledger = MemoryLedger(archive_segment_rows=2)
+        _seed(ledger)
+        got = _complete(ledger, "arc", 0)
+        _complete(ledger, "arc", 1)
+        _, cur0 = ledger.fetch_completed_since("arc", None)
+        revived = ledger.get("arc", got.id)
+        revived.status = "new"
+        revived.worker = None
+        revived.results = []
+        assert ledger.update_trial(revived, expected_status="completed")
+        batch, _ = ledger.fetch_completed_since("arc", None)
+        assert [t.id for t in batch] != []  # trial 1 still there
+        assert got.id not in [t.id for t in batch]
+
+
+class TestCursorsAcrossWalCompaction:
+    def test_cursor_survives_snapshot_and_wal_compact(self, tmp_path):
+        """The coordinator's snapshot() compacts the WAL under the
+        fence; a client cursor minted before must keep reading only the
+        delta after — same ledger instance, same epoch."""
+        from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap, archive_segment_rows=4) as srv:
+            host, port = srv.address
+            c = CoordLedgerClient(host=host, port=port)
+            _seed(c)
+            for i in range(6):
+                _complete(c, "arc", i)
+            _, cur = c.fetch_completed_since("arc", None)
+            srv.snapshot(snap)  # seals nothing, but compacts the WAL
+            expected = {}
+            for i in range(6, 10):
+                t = _complete(c, "arc", i)
+                expected[t.id] = float(i)
+            srv.snapshot(snap)
+            delta, cur2 = c.fetch_completed_since("arc", cur)
+            assert {t.id: t.objective for t in delta} == expected
+            again, _ = c.fetch_completed_since("arc", cur2)
+            assert len(again) == 0
+
+    def test_stale_cursor_after_restart_full_refetch(self, tmp_path):
+        """Across a restart (restore = a NEW MemoryLedger epoch) the old
+        cursor must degrade to a full refetch — repeats are absorbed by
+        observe-dedup; skips would be silent data loss."""
+        from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+
+        snap = str(tmp_path / "snap.json")
+        with CoordServer(snapshot_path=snap, archive_segment_rows=4) as srv:
+            c = CoordLedgerClient(host=srv.address[0], port=srv.address[1])
+            _seed(c)
+            for i in range(9):
+                _complete(c, "arc", i)
+            _, cur = c.fetch_completed_since("arc", None)
+        with CoordServer(snapshot_path=snap, archive_segment_rows=4) as srv:
+            c = CoordLedgerClient(host=srv.address[0], port=srv.address[1])
+            full, _ = c.fetch_completed_since("arc", cur)
+            objs = sorted(t.objective for t in full)
+            assert objs == [float(i) for i in range(9)]
+
+
+class TestCompletedBatchLaziness:
+    def test_batch_is_a_lazy_sequence(self):
+        arch = ExperimentArchive("arc", segment_rows=2)
+        docs = []
+        for i in range(4):
+            t = Trial(params={"x": float(i)}, experiment="arc")
+            t.transition("reserved")
+            t.attach_results(
+                [{"name": "objective", "type": "objective", "value": 1.0 * i}]
+            )
+            t.transition("completed")
+            docs.append(t.to_dict())
+            arch.append(t.to_dict())
+        entries = [arch.entry(d["id"]) for d in docs]
+        batch = CompletedBatch(entries)
+        assert len(batch) == 4
+        assert batch[0].to_dict() == docs[0]
+        assert [t.to_dict() for t in batch[1:3]] == docs[1:3]
+        # fresh object per materialization (clone-on-read)
+        assert batch[0] is not batch[0]
+
+
+class TestSortedIndexEdgeCases:
+    """Sealed rows are indexed by a sorted fixed-width (S24) key array;
+    these pin its escape hatches — ids the column cannot encode route
+    through the ``_odd`` side dict, uniform columns constant-fold to
+    scalars, and revive-then-recomplete leaves duplicate sorted keys
+    that lookup must resolve by liveness."""
+
+    @staticmethod
+    def _done(tid, i, worker="w0"):
+        t = Trial(id=tid, params={"x": float(i)}, experiment="arc")
+        t.transition("reserved")
+        t.worker = worker
+        t.attach_results(
+            [{"name": "objective", "type": "objective", "value": float(i)}]
+        )
+        t.transition("completed")
+        return t
+
+    @pytest.mark.parametrize("tid", [
+        "x" * 25,        # wider than the S24 column
+        "naïve-id",      # not ascii
+        "nul\x00",       # numpy strips trailing NULs on read
+    ])
+    def test_odd_ids_round_trip_and_discard(self, tid):
+        arch = ExperimentArchive("arc", segment_rows=2)
+        odd = self._done(tid, 0)
+        arch.append(odd.to_dict())
+        arch.append(self._done("aaaa", 1).to_dict())  # fills -> seals
+        stats = arch.stats()
+        assert stats["segments"] == 1 and stats["head_rows"] == 0
+        # the fixed-width column cannot hold the id: the row overflows
+        # whole and lookup goes through the side dict, not the S24 array
+        assert _id_key(tid) is None
+        assert stats["overflow_rows"] >= 1
+        assert tid in arch._odd
+        assert arch.contains(tid)
+        assert arch.get_doc(tid) == odd.to_dict()
+        # liveness flips work through the side dict too
+        assert arch.discard(tid)
+        assert not arch.contains(tid) and arch.get_doc(tid) is None
+        assert len(arch) == 1 and arch.stats()["dead_rows"] == 1
+        assert not arch.discard(tid)  # already dead
+
+    def test_odd_id_flows_through_completed_log(self):
+        """The ledger's completed log uses the same S24 buffer; an odd
+        id must survive the log -> cursor -> batch round trip intact."""
+        tid = "Ω" * 30
+        ledger = MemoryLedger(archive_segment_rows=2)
+        _seed(ledger)
+        t = Trial(id=tid, params={"x": 0.5}, experiment="arc")
+        ledger.register(t)
+        got = ledger.reserve("arc", "w0")
+        assert got.id == tid
+        got.attach_results(
+            [{"name": "objective", "type": "objective", "value": 7.0}]
+        )
+        got.transition("completed")
+        assert ledger.update_trial(got, expected_status="reserved")
+        _complete(ledger, "arc", 1)  # fills the segment -> seals
+        assert ledger.archive_stats("arc")["segments"] == 1
+        batch, _ = ledger.fetch_completed_since("arc", None)
+        assert [x.id for x in batch].count(tid) == 1
+        assert ledger.get("arc", tid).objective == 7.0
+
+    def test_uniform_columns_fold_to_scalars(self):
+        """All-same worker/lineage columns collapse to one scalar per
+        segment; decode must be indistinguishable from per-row storage."""
+        arch = ExperimentArchive("arc", segment_rows=4)
+        docs = []
+        for i in range(4):
+            t = self._done(f"same{i}", i, worker="w0")
+            docs.append(t.to_dict())
+            arch.append(t.to_dict())
+        seg = arch._segments[0]
+        assert isinstance(seg.worker, str)  # folded, not a per-row list
+        for row, d in enumerate(docs):
+            assert seg.decode(row) == d
+            assert arch.worker_of(f"same{row}") == "w0"
+
+    def test_mixed_columns_stay_per_row(self):
+        arch = ExperimentArchive("arc", segment_rows=4)
+        docs = []
+        for i in range(4):
+            t = self._done(f"mix{i}", i, worker=f"w{i}")
+            docs.append(t.to_dict())
+            arch.append(t.to_dict())
+        seg = arch._segments[0]
+        assert isinstance(seg.worker, list)
+        for row, d in enumerate(docs):
+            assert seg.decode(row) == d
+            assert arch.worker_of(f"mix{row}") == f"w{row}"
+
+    def test_duplicate_keys_resolve_to_live_row(self):
+        """Revive + recomplete leaves two sealed rows under the same
+        sorted key; the equal-key scan must land on the live one and
+        bulk reads must never resurface the dead one."""
+        ledger = MemoryLedger(archive_segment_rows=2)
+        _seed(ledger)
+        got = _complete(ledger, "arc", 0)
+        _complete(ledger, "arc", 1)  # seals segment 0
+        revived = ledger.get("arc", got.id)
+        revived.status = "new"
+        revived.worker = None
+        revived.results = []
+        assert ledger.update_trial(revived, expected_status="completed")
+        again = ledger.reserve("arc", "w9")
+        assert again.id == got.id
+        again.attach_results(
+            [{"name": "objective", "type": "objective", "value": 42.0}]
+        )
+        again.transition("completed")
+        assert ledger.update_trial(again, expected_status="reserved")
+        ledger.seal_archive("arc")  # the recompleted row seals too
+        stats = ledger.archive_stats("arc")
+        assert stats["head_rows"] == 0 and stats["dead_rows"] == 1
+        back = ledger.get("arc", got.id)
+        assert back.objective == 42.0 and back.status == "completed"
+        fetched = ledger.fetch("arc", "completed")
+        assert sorted(t.objective for t in fetched) == [1.0, 42.0]
