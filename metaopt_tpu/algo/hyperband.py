@@ -130,20 +130,31 @@ class Hyperband(BaseAlgorithm):
                 if bracket:
                     break
         if bracket is None:
-            # absorb: adopt into the first bracket with free capacity at
-            # this budget (exact-capacity bracket as fallback), so replaying
-            # a completed ledger reconstructs usable rung state
+            # absorb: adopt into a bracket whose ENTRY rung is this budget
+            # (that's where a stray of this budget was born), then any
+            # bracket with free capacity at this budget, then an
+            # exact-capacity bracket — so replaying a completed ledger
+            # reconstructs usable rung state. Entry-rung preference
+            # matters: dropping a sibling bracket's trial into a higher
+            # rung of an earlier bracket would occupy promotion slots the
+            # earlier bracket's own top performers are entitled to.
             fallback = None
             for b in self.brackets:
-                for r in b.rungs:
-                    if r.budget != budget:
-                        continue
-                    if not r.is_full:
-                        bracket = b
-                        break
-                    fallback = fallback or b
-                if bracket:
+                r0 = b.rungs[0]
+                if r0.budget == budget and not r0.is_full:
+                    bracket = b
                     break
+            if bracket is None:
+                for b in self.brackets:
+                    for r in b.rungs:
+                        if r.budget != budget:
+                            continue
+                        if not r.is_full:
+                            bracket = b
+                            break
+                        fallback = fallback or b
+                    if bracket:
+                        break
             bracket = bracket or fallback
             if bracket is None:
                 return

@@ -236,6 +236,7 @@ def default_race_config() -> RaceConfig:
         "ShardRouter": "metaopt_tpu.coord.shards",
         "ShardSupervisor": "metaopt_tpu.coord.shards",
         "BatchedExecutor": "metaopt_tpu.executor.batched",
+        "VirtualClock": "metaopt_tpu.sim.clock",
     }
     rc.race_exempt = {
         ("CoordServer", "_mut"),
@@ -308,6 +309,7 @@ def default_config() -> LintConfig:
         "ShardRouter": {"_conns_lock", "_map_lock"},
         "ShardSupervisor": {"_procs_lock"},
         "BatchedExecutor": {"_tel_lock"},
+        "VirtualClock": {"_lock"},
     }
     cfg.lock_factories = {
         "_exp_lock": (EXP_LOCK, ["CoordServer._exp_locks_guard"]),
@@ -348,6 +350,9 @@ def default_config() -> LintConfig:
         # residency bookkeeping dicts only; evict-file I/O and the WAL
         # sync happen between acquisitions, never under it
         "CoordServer._evict_lock",
+        # pure float arithmetic on the virtual "now"; a threaded server
+        # on a virtual clock takes it on every time()/monotonic() read
+        "VirtualClock._lock",
     }
     cfg.guarded_attrs = {
         "CoordServer": {
@@ -488,6 +493,12 @@ def default_config() -> LintConfig:
             "_launches": "BatchedExecutor._tel_lock",
             "_rows": "BatchedExecutor._tel_lock",
             "_pools": "BatchedExecutor._tel_lock",
+        },
+        "VirtualClock": {
+            # the virtual "now": every server/WAL/trial time read takes
+            # the lock, and a test's advance()/advance_to() races them
+            # when the clock is shared with a live threaded server
+            "_now": "VirtualClock._lock",
         },
     }
     cfg.receiver_roles = {

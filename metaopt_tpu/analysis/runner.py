@@ -218,7 +218,7 @@ def race_main(argv: Optional[Sequence[str]] = None,
                     "concurrency suites (MTR101 data races, MTR102 "
                     "lock-order inversions)")
     ap.add_argument("--suite", action="append", default=None,
-                    choices=("coord", "algo", "wal", "all"),
+                    choices=("coord", "algo", "wal", "sim", "all"),
                     help="workload(s) to run instrumented (repeatable; "
                          "default: all)")
     ap.add_argument("--scale", type=int, default=1,
@@ -237,7 +237,7 @@ def race_main(argv: Optional[Sequence[str]] = None,
 
     suites = args.suite or ["all"]
     if "all" in suites:
-        suites = ["coord", "algo", "wal"]
+        suites = ["coord", "algo", "wal", "sim"]
     if args.static_only:
         suites = []
 

@@ -43,6 +43,16 @@ shared state while instrumented:
 * ``wal`` — 4 appender threads doing append+sync group commits against
   a compactor thread and a final close(), kill-free (the chaos fault
   points stay unarmed), on a real file so fsync windows are realistic.
+* ``sim`` — a live threaded :class:`CoordServer` running on a shared
+  :class:`VirtualClock` (the scale simulator's clock seam) under client
+  worker threads, while an advancer thread pushes virtual time past
+  stale-sweep expiries. The discrete-event simulator itself is
+  single-threaded, but the seam is also used by tests that inject a
+  virtual clock into a *started* server — so ``VirtualClock._now``
+  under ``_lock`` must survive conn/housekeeping threads reading
+  ``time()``/``monotonic()`` against concurrent ``advance()`` calls,
+  and the sweep must keep CAS-releasing reservations whose heartbeats
+  aged out in virtual (not wall) time.
 
 Suites construct everything they touch INSIDE the instrumented region
 (locks must be minted under instrumentation to be wrapped) and join all
@@ -694,8 +704,91 @@ def suite_wal(scale: int = 1) -> None:
             raise errors[0]
 
 
+def suite_sim(scale: int = 1) -> None:
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+    from metaopt_tpu.ledger import Experiment
+    from metaopt_tpu.ledger.trial import set_trial_clock
+    from metaopt_tpu.sim.clock import VirtualClock
+    from metaopt_tpu.space import build_space
+
+    workers = 4
+    budget = workers * 4 * scale
+    clk = VirtualClock()
+    prev = set_trial_clock(clk)
+    try:
+        # generous VIRTUAL stale timeout: the advancer moves ~1 virtual
+        # second per 5 real ms, so expiries fire but in-flight trials
+        # usually finish first — both the sweep-release and the happy
+        # path get exercised
+        with CoordServer(host_algorithms=True, stale_timeout_s=60.0,
+                         sweep_interval_s=1.0, clock=clk) as s:
+            host, port = s.address
+            c0 = CoordLedgerClient(host=host, port=port)
+            Experiment(
+                "race-sim", c0,
+                space=build_space({"x": "uniform(-5, 5)"}),
+                max_trials=budget, pool_size=workers,
+                algorithm={"random": {"seed": 7}},
+            ).configure()
+            stop = threading.Event()
+            errors: List[BaseException] = []
+
+            def advancer() -> None:
+                # the simulator's event loop, compressed: advance races
+                # every time()/monotonic() read on conn + sweep threads
+                try:
+                    while not stop.is_set():
+                        clk.advance(1.0)
+                        clk.advance_to(clk.monotonic())
+                        stop.wait(0.005)
+                except BaseException as e:
+                    errors.append(e)
+
+            def worker(i: int) -> None:
+                try:
+                    c = CoordLedgerClient(host=host, port=port)
+                    complete = None
+                    for _ in range(budget * 6):
+                        out = c.worker_cycle(
+                            "race-sim", f"vw{i}", pool_size=workers,
+                            complete=complete)
+                        complete = None
+                        t = out["trial"]
+                        if t is None:
+                            if out["counts"]["completed"] >= budget:
+                                return
+                            continue
+                        t.attach_results([{
+                            "name": "objective", "type": "objective",
+                            "value": (t.params["x"] - 1) ** 2,
+                        }])
+                        t.transition("completed")
+                        complete = {"trial": t.to_dict(),
+                                    "expected_status": "reserved",
+                                    "expected_worker": f"vw{i}"}
+                except BaseException as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        name=f"race-sim-worker-{i}")
+                       for i in range(workers)]
+            adv = threading.Thread(target=advancer, name="race-sim-adv")
+            adv.start()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120.0)
+            stop.set()
+            adv.join(timeout=30.0)
+            if errors:
+                raise errors[0]
+    finally:
+        set_trial_clock(prev)
+
+
 SUITES: Dict[str, Callable[[int], None]] = {
     "coord": suite_coord,
     "algo": suite_algo,
     "wal": suite_wal,
+    "sim": suite_sim,
 }

@@ -70,7 +70,6 @@ import signal as _signal_mod
 import socket
 import sys as _sys
 import threading
-import time
 import uuid
 from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple, Union
@@ -100,6 +99,7 @@ from metaopt_tpu.ledger.backends import (
     MemoryLedger,
 )
 from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 log = logging.getLogger(__name__)
 
@@ -233,11 +233,12 @@ class _ProduceCoalescer:
             self.closed = False
 
     def __init__(self, producer, plock: threading.Lock, window_s: float,
-                 on_cycle=None) -> None:
+                 on_cycle=None, clock: Optional[Clock] = None) -> None:
         self.producer = producer
         self.plock = plock
         self.window_s = window_s
         self.on_cycle = on_cycle
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
         self._guard = threading.Lock()
         self._open: Optional["_ProduceCoalescer._Batch"] = None
 
@@ -254,7 +255,7 @@ class _ProduceCoalescer:
             b.done.wait()
         else:
             if self.window_s > 0:
-                time.sleep(self.window_s)
+                self.clock.sleep(self.window_s)
             with self._guard:
                 b.closed = True
                 if self._open is b:
@@ -321,7 +322,16 @@ class CoordServer:
         evict_dir: Optional[str] = None,
         archive_segment_rows: Optional[int] = None,
         archive_completed: bool = True,
+        clock: Optional[Clock] = None,
     ) -> None:
+        #: injectable time source (utils/clock.py). All wall stamps
+        #: (snapshot/event/heartbeat times) and all in-process intervals
+        #: (housekeeping cadence, evict idle tracking, drain deadlines)
+        #: flow through it; the scale simulator passes a VirtualClock so
+        #: a simulated hour of heartbeats costs microseconds. When an
+        #: explicit clock is given it is propagated to the inner backend
+        #: (heartbeat stamps + stale sweep share the same timeline).
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
         if inner is not None:
             self.inner = inner
         else:
@@ -329,6 +339,8 @@ class CoordServer:
             if archive_segment_rows is not None:
                 kw["archive_segment_rows"] = int(archive_segment_rows)
             self.inner = MemoryLedger(**kw)
+        if clock is not None:
+            self.inner.clock = self.clock
         self._bind = (host, port)
         #: same-host fast path: also listen on this Unix domain socket and
         #: advertise it in the ping reply — pod-local clients that can
@@ -519,6 +531,13 @@ class CoordServer:
         self._exp_last_touch: Dict[str, float] = {}
         self._evictions = 0
         self._hydrations = 0
+
+        #: housekeeping cadence stamps (monotonic — the historical code
+        #: kept these in wall time, which raced NTP steps). Initialized
+        #: here, not in the loop, so ``housekeeping_step()`` can be
+        #: driven directly on a virtual schedule with no loop thread.
+        self._last_sweep = self.clock.monotonic()
+        self._last_snap = self.clock.monotonic()
 
     def _exp_lock(self, name: Optional[str]) -> threading.RLock:
         if not name:
@@ -807,6 +826,7 @@ class CoordServer:
             self._wal = WriteAheadLog(
                 self.wal_path, fsync=self.wal_fsync,
                 group_window_s=self.wal_group_ms / 1000.0,
+                clock=self.clock,
             ).open(next_seq=last_seq + 1)
         if restored or replayed:
             # recovery grace: restored heartbeats are as old as the crash;
@@ -826,7 +846,7 @@ class CoordServer:
         # rebuild the tenant map (resident docs + evicted stubs) and stamp
         # every survivor as just-touched — the idle TTL must measure from
         # the restart, not evict the whole fleet on the first sweep
-        now = time.monotonic()
+        now = self.clock.monotonic()
         tenants: Dict[str, str] = {}
         for name in self.inner.list_experiments():
             doc = self.inner.load_experiment(name) or {}
@@ -960,35 +980,47 @@ class CoordServer:
 
     # -- background duties -------------------------------------------------
     def _housekeeping_loop(self) -> None:
-        last_snap = time.time()
-        last_sweep = time.time()
+        self._last_snap = self.clock.monotonic()
+        self._last_sweep = self.clock.monotonic()
         while not self._stopping.wait(min(self.sweep_interval_s, 1.0)):
-            if (
-                self.stale_timeout_s is not None
-                and time.time() - last_sweep >= self.sweep_interval_s
-            ):
-                for name in self.inner.list_experiments():
-                    released = self.ledger.release_stale(
-                        name, self.stale_timeout_s
-                    )
-                    for t in released:
-                        self._event("release_stale", name, trial=t.id)
-                last_sweep = time.time()
-            if self.snapshot_path and (
-                self._snap_soon.is_set()
-                or time.time() - last_snap >= self.snapshot_interval_s
-            ):
-                # _snap_soon: a serving thread handed off post-delete
-                # durability work rather than paying for a snapshot on
-                # the request path (the WAL already journals the delete)
-                self.snapshot(self.snapshot_path)
-                last_snap = time.time()
-            if self._evict_enabled and (self.evict_idle_s is not None
-                                        or self.max_resident is not None):
-                try:
-                    self.evict_sweep()
-                except Exception:
-                    log.exception("evict sweep failed")
+            self.housekeeping_step()
+
+    def housekeeping_step(self) -> None:
+        """One housekeeping beat: stale sweep, due snapshot, evict sweep.
+
+        Factored out of the loop so the scale simulator can drive the
+        exact production duties on a virtual schedule (no loop thread).
+        Cadence is measured on ``clock.monotonic()`` — the historical
+        wall-clock stamps made the sweep/snapshot cadence jump with NTP
+        steps while ``_stopping.wait`` ticked monotonically.
+        """
+        now = self.clock.monotonic()
+        if (
+            self.stale_timeout_s is not None
+            and now - self._last_sweep >= self.sweep_interval_s
+        ):
+            for name in self.inner.list_experiments():
+                released = self.ledger.release_stale(
+                    name, self.stale_timeout_s
+                )
+                for t in released:
+                    self._event("release_stale", name, trial=t.id)
+            self._last_sweep = self.clock.monotonic()
+        if self.snapshot_path and (
+            self._snap_soon.is_set()
+            or now - self._last_snap >= self.snapshot_interval_s
+        ):
+            # _snap_soon: a serving thread handed off post-delete
+            # durability work rather than paying for a snapshot on
+            # the request path (the WAL already journals the delete)
+            self.snapshot(self.snapshot_path)
+            self._last_snap = self.clock.monotonic()
+        if self._evict_enabled and (self.evict_idle_s is not None
+                                    or self.max_resident is not None):
+            try:
+                self.evict_sweep()
+            except Exception:
+                log.exception("evict sweep failed")
 
     # -- snapshot / restore ------------------------------------------------
     def snapshot(self, path: str) -> None:
@@ -1042,7 +1074,7 @@ class CoordServer:
                 trials[name] = self.inner.export_docs(name)
         state = {
             "version": 1,
-            "ts": time.time(),
+            "ts": self.clock.time(),
             "experiments": experiments,
             "trials": trials,
             "wal_seq": wal_seq,
@@ -1111,7 +1143,7 @@ class CoordServer:
             del self._snap_sections[stale]
         state = {
             "version": 2,
-            "ts": time.time(),
+            "ts": self.clock.time(),
             "sections": sections,
             "wal_seq": wal_seq,
         }
@@ -1336,12 +1368,12 @@ class CoordServer:
         """Fair-scheduling gate on one produce leg (tenancy.py)."""
         with self._tenant_lock:
             tenant = self._tenant_of.get(name, "default")
-            return self._sched.admit(tenant)
+            return self._sched.admit(tenant, now=self.clock.monotonic())
 
     def evict_sweep(self) -> int:
         """One eviction pass: idle-TTL victims first, then LRU victims
         down to the resident budget. Returns experiments evicted."""
-        now = time.monotonic()
+        now = self.clock.monotonic()
         with self._evict_lock:
             touch = dict(self._exp_last_touch)
             already = set(self._evicted)
@@ -1382,9 +1414,9 @@ class CoordServer:
             if name in self._migrating:
                 return False
             self._migrating[name] = "<evict>"
-            deadline = time.monotonic() + 5.0
+            deadline = self.clock.monotonic() + 5.0
             while self._exp_inflight.get(name, 0) > 0:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - self.clock.monotonic()
                 if remaining <= 0:
                     self._migrating.pop(name, None)
                     self._map_cv.notify_all()
@@ -1544,7 +1576,7 @@ class CoordServer:
         with self._evict_lock:
             self._evicted.pop(name, None)
             self._hydrations += 1
-            self._exp_last_touch[name] = time.monotonic()
+            self._exp_last_touch[name] = self.clock.monotonic()
         self._event("hydrate", name)
         return True
 
@@ -1598,7 +1630,8 @@ class CoordServer:
     def _event(self, op: str, experiment: Optional[str], **extra: Any) -> None:
         if not self.event_log_path:
             return
-        rec = {"ts": round(time.time(), 4), "op": op, "experiment": experiment}
+        rec = {"ts": round(self.clock.time(), 4), "op": op,
+               "experiment": experiment}
         rec.update(extra)
         try:
             with open(self.event_log_path, "a") as f:
@@ -1791,6 +1824,7 @@ class CoordServer:
                 self._coalescers[name] = _ProduceCoalescer(
                     entry[0], entry[1],
                     self.produce_coalesce_ms / 1000.0, on_cycle,
+                    clock=self.clock,
                 )
             coalescer = self._coalescers[name]
         return entry[0], entry[1], coalescer
@@ -2044,9 +2078,9 @@ class CoordServer:
                 # _DURABLE_OPS sender barrier.
                 self._wal.append({"op": "handoff_fence",
                                   "experiment": exp, "dest": dest})
-            deadline = time.monotonic() + drain_s
+            deadline = self.clock.monotonic() + drain_s
             while self._exp_inflight.get(exp, 0) > 0:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - self.clock.monotonic()
                 if remaining <= 0:
                     self._migrating.pop(exp, None)
                     if self._wal is not None:
@@ -2307,7 +2341,7 @@ class CoordServer:
         try:
             if self._evict_enabled:
                 with self._evict_lock:
-                    self._exp_last_touch[exp] = time.monotonic()
+                    self._exp_last_touch[exp] = self.clock.monotonic()
                     stubbed = exp in self._evicted
                 if stubbed and op not in self._NO_HYDRATE_OPS:
                     try:
@@ -2528,7 +2562,7 @@ class CoordServer:
                 self._tenant_of[name] = tenant
             if self._evict_enabled:
                 with self._evict_lock:
-                    self._exp_last_touch[name] = time.monotonic()
+                    self._exp_last_touch[name] = self.clock.monotonic()
             self._event("create_experiment", name)
             return None
         if op == "tenant_stats":

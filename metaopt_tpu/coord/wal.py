@@ -64,6 +64,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from metaopt_tpu.coord.protocol import (HAVE_WIRE_V2, ProtocolError,
                                         decode_body, encode_body)
+from metaopt_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 log = logging.getLogger(__name__)
 
@@ -217,10 +218,12 @@ class WriteAheadLog:
 
     def __init__(self, path: str, fsync: bool = True,
                  group_window_s: float = 0.0,
-                 binary: Optional[bool] = None) -> None:
+                 binary: Optional[bool] = None,
+                 clock: Optional[Clock] = None) -> None:
         self.path = path
         self.fsync = fsync
         self.group_window_s = group_window_s
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.binary = HAVE_WIRE_V2 if binary is None else (
             bool(binary) and HAVE_WIRE_V2)
         self._frame_rec = _frame_v2 if self.binary else _frame_v1
@@ -333,8 +336,7 @@ class WriteAheadLog:
             if self.group_window_s > 0:
                 # let the burst pile in — same amortization window doctrine
                 # as _ProduceCoalescer (0 = fsync-duration batching only)
-                import time as _time
-                _time.sleep(self.group_window_s)
+                self.clock.sleep(self.group_window_s)
             # one batch per leader, then hand off: keeping the leader role
             # across batches was measured SLOWER at 32-worker fan-in (the
             # leader's own acked client idles while it writes strangers'

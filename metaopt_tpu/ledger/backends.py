@@ -37,6 +37,7 @@ import numpy as np
 from metaopt_tpu.ledger.archive import (CompletedBatch, ExperimentArchive,
                                         _id_key)
 from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from metaopt_tpu.utils.registry import Registry
 
 log = logging.getLogger(__name__)
@@ -64,6 +65,12 @@ class AdmissionError(RuntimeError):
 
 class LedgerBackend(ABC):
     """Storage + concurrency contract. All methods are atomic per call."""
+
+    #: Time source for heartbeat stamps and the stale sweep.  Class-level
+    #: default so no backend constructor needs to thread it; the
+    #: coordinator (and the scale simulator through it) overwrites the
+    #: instance attribute when given an explicit clock.
+    clock: Clock = SYSTEM_CLOCK
 
     # -- experiment documents --------------------------------------------
     @abstractmethod
@@ -157,7 +164,7 @@ class LedgerBackend(ABC):
         semantics); the lineage later added a pacemaker. Here it is part of
         the backend contract.
         """
-        now = time.time()
+        now = self.clock.time()
         released = []
         for t in self.fetch(experiment, "reserved"):
             if t.heartbeat is not None and now - t.heartbeat > timeout_s:
@@ -464,7 +471,7 @@ class MemoryLedger(LedgerBackend):
             t = self._trials.get(experiment, {}).get(trial_id)
             if t is None or t.status != "reserved" or t.worker != worker:
                 return False
-            t.heartbeat = time.time()
+            t.heartbeat = self.clock.time()
             return True
 
     def get(self, experiment: str, trial_id: str) -> Optional[Trial]:
@@ -1170,7 +1177,7 @@ class FileLedger(LedgerBackend):
             doc = self._read_json(path)
             if not doc or doc.get("status") != "reserved" or doc.get("worker") != worker:
                 return False
-            doc["heartbeat"] = time.time()
+            doc["heartbeat"] = self.clock.time()
             pre = self._dir_mtime(experiment)
             self._write_json(path, doc)
             self._stamp_dir(experiment, pre)

@@ -12,11 +12,28 @@ recording which chips/sub-slice the gang scheduler pinned the trial to.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Mapping, Optional
 
+from metaopt_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from metaopt_tpu.utils.hashing import jsonable, point_hash
+
+#: Clock used for submit/start/end/heartbeat stamps.  Trials are
+#: constructed all over the tree (workers, server replay, CLI), so the
+#: seam is a module-level source rather than a per-instance parameter;
+#: the simulator swaps it for a VirtualClock via ``set_trial_clock``.
+_CLOCK: Clock = SYSTEM_CLOCK
+
+
+def set_trial_clock(clock: Optional[Clock]) -> Clock:
+    """Install ``clock`` (or restore the system clock with ``None``) as
+    the source for Trial timestamps; returns the previous clock so
+    callers can restore it.
+    """
+    global _CLOCK
+    prev = _CLOCK
+    _CLOCK = clock if clock is not None else SYSTEM_CLOCK
+    return prev
 
 #: Legal status values and transitions.
 STATUSES = ("new", "reserved", "completed", "interrupted", "broken", "suspended")
@@ -93,7 +110,7 @@ class Trial:
         if not self.id:
             self.id = point_hash(self.params)
         if self.submit_time is None:
-            self.submit_time = time.time()
+            self.submit_time = _CLOCK.time()
         if self.status not in STATUSES:
             raise ValueError(f"unknown status {self.status!r}")
         self.results = [
@@ -109,7 +126,7 @@ class Trial:
                 f"trial {self.id}: illegal {self.status} → {new_status}"
             )
         self.status = new_status
-        now = time.time()
+        now = _CLOCK.time()
         if new_status == "reserved":
             self.start_time = now
             self.heartbeat = now

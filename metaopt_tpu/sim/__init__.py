@@ -1,0 +1,16 @@
+"""Discrete-event scale simulator (ROADMAP item 1, ISSUE 18).
+
+Drives the REAL coordinator — `CoordServer` dispatch, WAL/snapshot/
+reply-cache durability, hosted ASHA/hyperband/BOHB promotion, the fair
+produce scheduler, heartbeats and the stale sweep — with tens of
+thousands of simulated workers on a virtual clock, so pod-scale
+robustness claims become repeatable sub-minute CI checks.
+
+Entry points: ``mtpu simulate`` (CLI), :class:`Simulation` (library),
+``benchmarks/sim_scale.py`` (certified-metric driver).
+"""
+
+from metaopt_tpu.sim.clock import VirtualClock
+from metaopt_tpu.sim.engine import SimConfig, SimReport, Simulation
+
+__all__ = ["VirtualClock", "SimConfig", "SimReport", "Simulation"]

@@ -1,0 +1,58 @@
+"""Injectable time source for the coordinator and the scale simulator.
+
+Every component that stamps or compares times goes through a ``Clock``
+instead of calling :mod:`time` directly, so the discrete-event simulator
+(:mod:`metaopt_tpu.sim`) can drive the *real* coordinator, WAL, heartbeat
+bookkeeping, and stale sweep on a virtual timeline that advances in
+microseconds of wall time.
+
+Two distinct timelines are exposed, mirroring the stdlib:
+
+``time()``
+    Wall-clock seconds since the epoch.  Used for *stamps that outlive
+    the process* — trial submit/heartbeat/end times, snapshot and event
+    log timestamps — because they are compared against stamps written by
+    earlier incarnations of the server.
+
+``monotonic()``
+    Process-relative seconds.  Used for *intervals within a process* —
+    eviction idle tracking, fair-scheduler windows, housekeeping
+    cadence, drain deadlines — where wall-clock jumps must not matter.
+
+The historical bug class this seam retires: mixing the two (e.g. a
+housekeeping cadence kept in wall time racing an NTP step).  A
+``VirtualClock`` (see ``metaopt_tpu/sim/clock.py``) keeps both timelines
+in lockstep offsets of one virtual "now", which preserves the contract
+while making a simulated hour cost nothing.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+
+class Clock:
+    """Real time source; the default for every production code path.
+
+    Thin, allocation-free pass-throughs to :mod:`time`.  Subclasses
+    (``VirtualClock``) override all three methods; callers must never
+    cache the underlying functions.
+    """
+
+    def time(self) -> float:
+        """Wall-clock seconds since the epoch (persistent stamps)."""
+        return _time.time()
+
+    def monotonic(self) -> float:
+        """Monotonic seconds (in-process intervals and deadlines)."""
+        return _time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        """Block for ``seconds``; virtual clocks make this free."""
+        if seconds > 0:
+            _time.sleep(seconds)
+
+
+#: Process-wide default.  Components take ``clock=None`` and fall back to
+#: this so the common path never pays for plumbing it explicitly.
+SYSTEM_CLOCK = Clock()

@@ -1,0 +1,76 @@
+"""Scale-simulator certification runs (ISSUE 18 tentpole).
+
+Tier-1 carries the 1k-worker smoke certification; the 100k-worker
+pod-scale run (the ISSUE's headline claim: < 5 min wall, zero promotion
+violations, zero acked-write loss, deterministic event log) is marked
+``slow`` and runs in the chaos tier alongside the kill-9 sweeps.
+"""
+
+import pytest
+
+from metaopt_tpu.sim import SimConfig, Simulation
+from metaopt_tpu.sim.engine import DEFAULT_FAULTS
+
+
+def certify(rep):
+    assert rep.promotion_violations == [], rep.promotion_violations
+    assert rep.acked_write_losses == [], rep.acked_write_losses
+    assert rep.exactly_once_violations == [], rep.exactly_once_violations
+    assert rep.ok
+
+
+class TestSmoke1k:
+    """1000 workers, mixed algorithms, default chaos — tier-1."""
+
+    def test_1k_workers_mixed_algos_certify(self):
+        cfg = SimConfig(
+            workers=1000, tenants=4, experiments_per_tenant=2,
+            algos=("asha", "hyperband", "random", "tpe"),
+            max_trials=32, seed=0, faults=DEFAULT_FAULTS,
+        )
+        rep = Simulation(cfg).run()
+        certify(rep)
+        assert rep.acked_completions == 8 * 32
+        # equal worker shares + equal budgets → near-perfect fairness
+        assert rep.jain >= 0.9, rep.completed_by_tenant
+        assert rep.crashes == 2  # DEFAULT_FAULTS arms two server crashes
+        assert rep.wall_s < 120.0
+
+    def test_1k_recovery_time_bounded_by_wal_length(self):
+        """Recovery wall time stays proportional to WAL length: the
+        post-replay auto-snapshot compacts the WAL, so a later crash
+        replays a short log even late in the run."""
+        cfg = SimConfig(
+            workers=1000, tenants=2, experiments_per_tenant=1,
+            max_trials=32, seed=1,
+            faults="sim_crash_server:3@40",
+        )
+        rep = Simulation(cfg).run()
+        certify(rep)
+        assert len(rep.recoveries) == 3
+        assert rep.recovery_s_per_10k_wal is not None
+        # generous CI-box bound: a 10k-record replay under a minute
+        assert rep.recovery_s_per_10k_wal < 60.0
+
+
+@pytest.mark.slow
+class TestCertify100k:
+    """The pod-scale certification: 100k simulated workers."""
+
+    def test_100k_workers_certified_under_five_minutes(self):
+        cfg = SimConfig(workers=100_000, seed=0, faults=DEFAULT_FAULTS)
+        rep = Simulation(cfg).run()
+        certify(rep)
+        assert rep.wall_s < 300.0, f"{rep.wall_s}s blows the CI budget"
+        assert rep.jain >= 0.9, rep.completed_by_tenant
+        assert rep.acked_completions == 8 * 64
+        assert rep.event_log_sha256
+
+    def test_100k_same_seed_reproduces_digest(self):
+        digests = {
+            Simulation(SimConfig(
+                workers=100_000, seed=0, faults=DEFAULT_FAULTS,
+            )).run().event_log_sha256
+            for _ in range(2)
+        }
+        assert len(digests) == 1

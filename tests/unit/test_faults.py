@@ -58,6 +58,65 @@ class TestInjector:
         assert all(inj.fire("spawn_fail") for _ in range(3))
 
 
+class TestProbabilisticInjector:
+    """The seeded ``kind:p=<prob>@<seed>`` rules the scale simulator
+    builds its reproducible fault schedules on."""
+
+    def test_same_seed_same_firing_pattern(self):
+        a = FaultInjector(spec="flake:p=0.3@7")
+        b = FaultInjector(spec="flake:p=0.3@7")
+        pattern = [a.fire("flake") for _ in range(200)]
+        assert pattern == [b.fire("flake") for _ in range(200)]
+        assert 20 < sum(pattern) < 120  # ~60 expected at p=0.3
+
+    def test_different_seed_different_pattern(self):
+        a = FaultInjector(spec="flake:p=0.3@7")
+        b = FaultInjector(spec="flake:p=0.3@8")
+        assert [a.fire("flake") for _ in range(200)] != \
+               [b.fire("flake") for _ in range(200)]
+
+    def test_streams_are_per_kind_and_isolated(self):
+        """Consulting one kind must not perturb another kind's stream —
+        the property that makes a whole fault schedule replayable even
+        when the mix of consults changes."""
+        solo = FaultInjector(spec="a:p=0.5@1")
+        solo_pattern = [solo.fire("a") for _ in range(100)]
+        mixed = FaultInjector(spec="a:p=0.5@1,b:p=0.5@2")
+        mixed_pattern = []
+        for _ in range(100):
+            mixed.fire("b")
+            mixed_pattern.append(mixed.fire("a"))
+        assert mixed_pattern == solo_pattern
+
+    def test_deterministic_rules_take_precedence(self):
+        inj = FaultInjector(spec="x:2@0")
+        inj.arm_probability("x", 1.0, seed=0)
+        # the two deterministic charges drain first...
+        assert inj.fire("x") and inj.fire("x")
+        # ...then the p=1.0 rule keeps firing indefinitely
+        assert all(inj.fire("x") for _ in range(5))
+
+    def test_disarm_and_reset(self):
+        inj = FaultInjector(spec="x:p=1.0@0")
+        assert inj.fire("x")
+        inj.arm_probability("x", 0.0)  # p<=0 disarms
+        assert not inj.fire("x")
+        inj2 = FaultInjector(spec="x:p=1.0@0")
+        inj2.reset()
+        assert not inj2.fire("x")
+
+    def test_malformed_prob_spec_is_ignored(self, monkeypatch):
+        monkeypatch.setenv("METAOPT_TPU_FAULTS", "x:p=nope@3,y:1")
+        inj = FaultInjector()
+        assert not inj.fire("x")
+        assert inj.fire("y")
+
+    def test_unarmed_fast_path_with_prob_rules(self):
+        inj = FaultInjector(spec="x:p=1.0@0")
+        assert not inj.fire("unrelated")
+        assert inj.fire("x")
+
+
 class TestExecutorFaults:
     def test_spawn_fail_breaks_trial(self):
         trial, ex = make_executor()

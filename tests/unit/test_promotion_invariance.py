@@ -1,0 +1,197 @@
+"""Promotion invariance to completion order (ISSUE 18 satellite).
+
+The scale simulator certifies promotion structure under one interleaving
+per seed; these property tests sweep MANY completion orders — including
+adversarial straggler orders that hold the best results back — over a
+fixed trial set and assert what each halving variant actually
+guarantees:
+
+- ASHA (asynchronous): the interim promotion trace is order-DEPENDENT
+  by design (promote on partial information), but (a) the structural
+  safety invariants hold under every order — promotions only from
+  eta-filled rungs, the ``n - eta + 1`` total bound, no rung-skipping —
+  and (b) once promotions are drained to a fixed point, the rung's
+  final top ``n // eta`` lineages are all promoted and the globally
+  best lineage reaches the top rung, under EVERY order.
+- Hyperband (synchronous): the barrier makes the ENTIRE final bracket
+  state a pure function of the result set — byte-identical
+  ``state_dict`` across all completion orders.
+"""
+
+import random
+
+import pytest
+
+from metaopt_tpu.algo import ASHA, Hyperband
+from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.sim.certify import asha_violations, hyperband_violations
+from metaopt_tpu.space import build_space
+
+SPACE = {"x": "uniform(-5, 5)", "epochs": "fidelity(1, 4, base=2)"}
+
+
+def build():
+    return build_space(SPACE)
+
+
+def objective(params):
+    # deterministic, budget-consistent: same x always ranks the same way
+    return float(params["x"]) ** 2
+
+
+def completed(space, params):
+    t = Trial(params=dict(params), experiment="e")
+    t.lineage = space.hash_point(
+        {**params, "epochs": space.fidelity.rungs()[0]}
+    )
+    t.transition("reserved")
+    t.attach_results([
+        {"name": "o", "type": "objective", "value": objective(params)}
+    ])
+    t.transition("completed")
+    return t
+
+
+def orders(n, seeds=(0, 1, 2, 3)):
+    """Shuffled completion orders plus the two adversarial extremes:
+    best-first and worst-first (the maximal straggler delay — every
+    good result arrives after every bad one)."""
+    base = list(range(n))
+    out = [list(base), list(reversed(base))]
+    for s in seeds:
+        perm = list(base)
+        random.Random(s).shuffle(perm)
+        out.append(perm)
+    return out
+
+
+class TestASHAInvariance:
+    N = 12
+
+    def run_order(self, order):
+        space = build()
+        algo = ASHA(space, seed=7)
+        base_budget = space.fidelity.rungs()[0]
+        # fixed trial set: the SAME sampled base points for every order
+        pts = [
+            {**p, "epochs": base_budget}
+            for p in build().sample(self.N, seed=123)
+        ]
+        pending = [pts[i] for i in order]
+        rng = random.Random(sum(order))
+        while pending:
+            params = pending.pop(0)
+            algo.observe([completed(space, params)])
+            # drain every promotion now available; promoted trials
+            # complete later at a random point in the remaining order
+            # (straggler interleaving for the upper rungs too)
+            while True:
+                promoted = None
+                for bracket in algo.brackets:
+                    promoted = bracket.promote(algo.eta)
+                    if promoted is not None:
+                        break
+                if promoted is None:
+                    break
+                p, budget = promoted
+                pending.insert(
+                    rng.randrange(len(pending) + 1),
+                    {**p, "epochs": budget},
+                )
+        return algo
+
+    def test_safety_invariants_under_every_order(self):
+        for order in orders(self.N):
+            algo = self.run_order(order)
+            assert asha_violations(algo) == [], f"order {order}"
+
+    def test_topk_closure_and_best_reaches_top_under_every_order(self):
+        for order in orders(self.N):
+            algo = self.run_order(order)
+            # promotions drained → quiescent closure must hold
+            assert asha_violations(algo, quiescent=True) == [], \
+                f"order {order}"
+            rungs = algo.brackets[0].rungs
+            best = min(rungs[0].results.items(), key=lambda kv: kv[1][0])
+            # the globally best lineage climbed the whole ladder
+            for rung in rungs[1:]:
+                assert best[0] in rung.results, (
+                    f"best lineage stranded below budget {rung.budget} "
+                    f"under order {order}"
+                )
+
+    def test_worst_first_order_overpromotes_within_bound(self):
+        """Document WHY the naive ``n // eta`` cap is not an invariant:
+        the strictly-worst-first order promotes interim 'best' lineages
+        that later ranks displace — legal ASHA behavior, bounded by
+        ``n - eta + 1``."""
+        algo = self.run_order(list(reversed(range(self.N))))
+        rung0 = algo.brackets[0].rungs[0]
+        n, eta = len(rung0.results), algo.eta
+        assert len(rung0.promoted) <= n - eta + 1
+        assert asha_violations(algo) == []
+
+
+class TestHyperbandInvariance:
+    def run_order(self, seed_order):
+        space = build()
+        algo = Hyperband(space, seed=11, repetitions=1)
+        pending = []
+        rng = random.Random(seed_order)
+        while True:
+            for p in algo.suggest(4):
+                pending.append(p)
+            if not pending:
+                break
+            i = rng.randrange(len(pending))
+            algo.observe([completed(space, pending.pop(i))])
+        return algo
+
+    def test_final_state_identical_across_orders(self):
+        states = []
+        algos = []
+        for seed_order in range(6):
+            algo = self.run_order(seed_order)
+            state = algo.state_dict()
+            state.pop("rng", None)  # rng position varies with resampling
+            states.append(state["brackets"])
+            algos.append(algo)
+        for s in states[1:]:
+            assert s == states[0], (
+                "synchronous bracket state diverged across completion "
+                "orders"
+            )
+        for algo in algos:
+            assert hyperband_violations(algo, quiescent=True) == []
+
+    def test_barrier_blocks_until_rung_complete(self):
+        space = build()
+        algo = Hyperband(space, seed=11, repetitions=1)
+        first = algo.suggest(64)
+        # every first-wave suggestion is an entry-rung fill, no promotion
+        for bracket in algo.brackets:
+            assert not any(
+                r.results or (r.assigned and r is not bracket.rungs[0])
+                for r in bracket.rungs[1:]
+            )
+        # complete all but one of bracket 0's entry rung: still barred
+        r0 = algo.brackets[0].rungs[0]
+        held_back = None
+        done = 0
+        for p in first:
+            lin = space.hash_point(p)
+            if lin not in r0.assigned:
+                continue
+            if done == len(r0.assigned) - 1:
+                held_back = p
+                break
+            algo.observe([completed(space, p)])
+            done += 1
+        assert held_back is not None
+        assert not r0.is_complete
+        assert algo.brackets[0].next_action() is None
+        # the straggler lands: the rung completes, promotion unblocks
+        algo.observe([completed(space, held_back)])
+        assert r0.is_complete
+        kind, _ = algo.brackets[0].next_action()
+        assert kind == "promote"

@@ -1,0 +1,223 @@
+"""Scale-simulator tier-1 tests: clock seam, determinism, durability.
+
+The heavy certification runs live in tests/functional/test_sim_scale.py;
+these cover the building blocks fast: VirtualClock semantics, the
+injectable-clock seam through CoordServer/ledger/Trial (including the
+recovery-grace heartbeat refresh this PR pins down), fault-schedule
+reproducibility, and small end-to-end simulations with crash faults.
+"""
+
+import json
+import os
+
+import pytest
+
+from metaopt_tpu.coord.server import CoordServer
+from metaopt_tpu.ledger.trial import Trial, set_trial_clock
+from metaopt_tpu.sim import SimConfig, Simulation, VirtualClock
+from metaopt_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+
+class TestVirtualClock:
+    def test_wall_and_monotonic_move_in_lockstep(self):
+        c = VirtualClock()
+        t0, m0 = c.time(), c.monotonic()
+        c.advance(5.0)
+        assert c.monotonic() == m0 + 5.0
+        assert c.time() == t0 + 5.0
+
+    def test_sleep_advances_instead_of_blocking(self):
+        c = VirtualClock()
+        c.sleep(3600.0)  # a simulated hour costs nothing
+        assert c.monotonic() == 3600.0
+        c.sleep(0.0)
+        c.sleep(-1.0)  # no-op, mirroring time.sleep's refusal domain
+        assert c.monotonic() == 3600.0
+
+    def test_advance_to_never_goes_backwards(self):
+        c = VirtualClock()
+        c.advance_to(10.0)
+        c.advance_to(4.0)  # same-instant heap pops must not rewind
+        assert c.monotonic() == 10.0
+        with pytest.raises(ValueError):
+            c.advance(-1.0)
+
+    def test_system_clock_tracks_real_time(self):
+        assert isinstance(SYSTEM_CLOCK, Clock)
+        import time as _t
+        assert abs(SYSTEM_CLOCK.time() - _t.time()) < 5.0
+
+
+class TestClockSeam:
+    def test_trial_stamps_follow_injected_clock(self):
+        clk = VirtualClock(start=100.0)
+        prev = set_trial_clock(clk)
+        try:
+            t = Trial(params={"x": 1}, experiment="e")
+            assert t.submit_time == clk.time()
+            clk.advance(7.0)
+            t.transition("reserved")
+            assert t.start_time == clk.time()
+        finally:
+            set_trial_clock(prev)
+        # restored: new trials stamp from the system clock again
+        t2 = Trial(params={"x": 2}, experiment="e")
+        assert abs(t2.submit_time - SYSTEM_CLOCK.time()) < 5.0
+
+    def test_stale_sweep_runs_on_virtual_time(self, tmp_path):
+        clk = VirtualClock()
+        prev = set_trial_clock(clk)
+        srv = CoordServer(
+            host_algorithms=True, stale_timeout_s=30.0,
+            sweep_interval_s=5.0, produce_coalesce_ms=0.0, clock=clk,
+        )
+        try:
+            srv._recover()
+            assert srv.inner.clock is clk
+            srv._handle({"op": "create_experiment", "req": "c", "args": {
+                "config": {"name": "e1",
+                           "space": {"x": "uniform(0, 1)"},
+                           "algorithm": {"random": {"seed": 1}},
+                           "max_trials": 4, "pool_size": 2}}})
+            r = srv._handle({"op": "worker_cycle", "req": "w", "args": {
+                "experiment": "e1", "worker": "w0", "pool_size": 2,
+                "produce": True}})["result"]
+            assert r["trial"] is not None
+            # no heartbeats for 31 virtual seconds → the sweep frees it
+            clk.advance(31.0)
+            srv.housekeeping_step()
+            t = srv.inner.get("e1", r["trial"]["id"])
+            assert t.status == "new", "stale sweep missed a virtual expiry"
+        finally:
+            srv.stop()
+            set_trial_clock(prev)
+
+    def test_recovery_grace_refreshes_restored_heartbeats(self, tmp_path):
+        """Pins the server.py recovery-grace semantics: reservations
+        restored from snapshot+WAL get their heartbeats re-stamped to
+        recovery time, so a sweep right after restart does NOT free
+        trials whose workers are alive — they get a full stale_timeout
+        to re-assert themselves, measured from recovery, not the crash."""
+        clk = VirtualClock()
+        prev = set_trial_clock(clk)
+        snap = str(tmp_path / "c.snap")
+
+        def boot():
+            s = CoordServer(
+                snapshot_path=snap, host_algorithms=True,
+                stale_timeout_s=30.0, sweep_interval_s=5.0,
+                produce_coalesce_ms=0.0, wal_fsync=False,
+                wal_group_ms=0.0, clock=clk,
+            )
+            s._recover()
+            return s
+
+        srv = boot()
+        try:
+            srv._handle({"op": "create_experiment", "req": "c", "args": {
+                "config": {"name": "e1",
+                           "space": {"x": "uniform(0, 1)"},
+                           "algorithm": {"random": {"seed": 1}},
+                           "max_trials": 4, "pool_size": 2}}})
+            r = srv._handle({"op": "worker_cycle", "req": "w", "args": {
+                "experiment": "e1", "worker": "w0", "pool_size": 2,
+                "produce": True}})["result"]
+            tid = r["trial"]["id"]
+            srv._wal.sync(srv._barrier_seq("worker_cycle"))
+            # crash 29 virtual seconds after the reservation: heartbeat
+            # on disk is nearly stale
+            clk.advance(29.0)
+            srv._wal._f.close()
+            srv = boot()
+            # 2s later (29 + 2 > 30 from the ORIGINAL stamp) the sweep
+            # must NOT free it — grace re-aged the heartbeat to recovery
+            clk.advance(5.0 + 2.0)
+            srv.housekeeping_step()
+            assert srv.inner.get("e1", tid).status == "reserved"
+            # but a worker that stays silent a full timeout past
+            # recovery IS swept
+            clk.advance(30.0)
+            srv.housekeeping_step()
+            assert srv.inner.get("e1", tid).status == "new"
+        finally:
+            srv.stop()
+            set_trial_clock(prev)
+
+
+def small_cfg(**kw):
+    kw.setdefault("workers", 40)
+    kw.setdefault("tenants", 2)
+    kw.setdefault("experiments_per_tenant", 1)
+    kw.setdefault("max_trials", 16)
+    kw.setdefault("seed", 0)
+    return SimConfig(**kw)
+
+
+class TestSimulationSmall:
+    def test_runs_to_completion_and_certifies(self):
+        rep = Simulation(small_cfg()).run()
+        assert rep.ok
+        assert rep.experiments == 2
+        assert rep.acked_completions == 2 * 16
+        assert rep.completed_by_tenant == {"t0": 16, "t1": 16}
+        assert rep.jain == 1.0
+        assert rep.virtual_s < rep.config["max_virtual_s"]
+
+    def test_same_seed_byte_identical_event_log(self, tmp_path):
+        logs = []
+        for i in range(2):
+            path = str(tmp_path / f"ev{i}.jsonl")
+            rep = Simulation(small_cfg(
+                seed=5, faults="sim_worker_death:p=0.01@1,"
+                               "sim_crash_server:1@12",
+                event_log=path,
+            )).run()
+            with open(path, "rb") as f:
+                logs.append(f.read())
+            assert rep.event_log_sha256
+        assert logs[0] == logs[1]
+        # and the log is replay-grade: parseable, virtually-timestamped
+        events = [json.loads(l) for l in logs[0].splitlines()]
+        assert all("t" in e and "ev" in e for e in events)
+        assert [e["t"] for e in events] == sorted(e["t"] for e in events)
+
+    def test_different_seed_different_log(self):
+        a = Simulation(small_cfg(seed=1)).run()
+        b = Simulation(small_cfg(seed=2)).run()
+        assert a.event_log_sha256 != b.event_log_sha256
+
+    def test_crash_faults_lose_no_acked_write(self):
+        rep = Simulation(small_cfg(
+            seed=3, faults="sim_crash_server:3@8",
+        )).run()
+        assert rep.crashes == 3
+        assert rep.recoveries and all(
+            r["wall_s"] >= 0 for r in rep.recoveries)
+        assert rep.acked_write_losses == []
+        assert rep.exactly_once_violations == []
+        assert rep.ok
+
+    def test_worker_death_and_stale_release_still_complete(self):
+        rep = Simulation(small_cfg(
+            seed=4, workers=30,
+            faults="sim_worker_death:p=0.05@9,sim_lost_heartbeat:p=0.1@2",
+        )).run()
+        assert rep.ok
+        assert rep.acked_completions == 2 * 16
+        # the chaos actually happened — deaths or delayed completions
+        assert rep.worker_deaths + rep.cas_rejected_completions > 0
+
+    def test_hyperband_certifies_under_crash(self):
+        rep = Simulation(small_cfg(
+            algos=("hyperband",), max_trials=20, seed=0,
+            faults="sim_crash_server:1@15",
+        )).run()
+        assert rep.promotion_violations == []
+        assert rep.ok
+
+    def test_no_threads_leak_from_unstarted_server(self):
+        import threading
+        before = {t.name for t in threading.enumerate()}
+        Simulation(small_cfg(seed=6)).run()
+        after = {t.name for t in threading.enumerate()}
+        assert not {n for n in after - before if n.startswith("coord-")}
