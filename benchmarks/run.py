@@ -305,6 +305,18 @@ def main() -> int:
     observed = backend
     if backend == "tpu":
         observed = "tpu" if tpu_backend_reachable(60.0) else "unverified"
+    # informational: how long the full crash-consistency certification
+    # takes on this box (all five dynamic suites + statics). Tracked for
+    # drift, never gated — a bench run must not fail on an analysis bug
+    try:
+        from metaopt_tpu.analysis.crashcheck import SUITES
+        from metaopt_tpu.analysis.runner import run_crashcheck
+        t0 = time.monotonic()
+        run_crashcheck(list(SUITES))
+        crashcheck_runtime_s = round(time.monotonic() - t0, 3)
+    except Exception as exc:  # noqa: BLE001
+        crashcheck_runtime_s = None
+        print(json.dumps({"crashcheck_error": str(exc)}), flush=True)
     summary = {
         "summary": True,
         "scale": args.scale,
@@ -315,6 +327,7 @@ def main() -> int:
         "total_trials": sum(r["trials"] for r in ok),
         "total_requeued": sum(r.get("requeued", 0) for r in ok),
         "total_wall_s": round(sum(r["wall_s"] for r in results), 1),
+        "crashcheck_runtime_s": crashcheck_runtime_s,
         **provenance(run=run_id),
     }
     print(json.dumps(summary))

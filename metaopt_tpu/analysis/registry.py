@@ -509,3 +509,46 @@ def default_config() -> LintConfig:
     cfg.no_fallback_classes = {"CoordLedgerClient"}
     cfg.hotpath_registry = set()
     return cfg
+
+
+@dataclass
+class CrashConfig:
+    """Declarations specific to ``mtpu crashcheck`` (the MTP persistence-
+    order checkers and the crash-state enumeration suites). Same doctrine
+    as :class:`LintConfig`: tests build small configs of this shape for
+    fixture modules, so the checkers stay config-driven and hermetic."""
+
+    #: module basename whose ``DURABLE_SEQUENCES`` dict literal declares
+    #: the ordered-step protocols MTP003 enforces
+    protocol_module: str = "protocol.py"
+    #: explicit registry override (tests); None = parse the module
+    durable_sequences: Optional[Dict[str, Dict[str, object]]] = None
+    #: qualname prefixes of ack-publishing functions: every network send
+    #: inside one must be preceded (in source order) by a WAL sync —
+    #: MTP002. Prefix-matched so nested sender closures are covered.
+    ack_publishers: Set[str] = field(default_factory=lambda: {
+        "CoordServer._serve_conn",
+    })
+    #: receiver names whose ``.append``/``.sync`` are WAL journal effects
+    wal_receivers: Set[str] = field(default_factory=lambda: {
+        "_wal", "wal", "self._wal", "self.wal",
+    })
+    #: call-name tails that put ack bytes on the wire
+    ack_calls: Set[str] = field(default_factory=lambda: {
+        "send_payload", "send_msg", "sendall",
+    })
+    #: fault-arming indirection for MTP004: module-level string-constant
+    #: assignments whose target name contains one of these markers count
+    #: as arming every ``kind:`` spec they embed, provided the constant's
+    #: NAME appears in the tests tree (tests import the spec wholesale —
+    #: e.g. sim/engine.py's DEFAULT_FAULTS in test_sim_scale.py)
+    fault_const_markers: Set[str] = field(default_factory=lambda: {
+        "FAULTS",
+    })
+    #: directory scanned for fault-kind arming (None = <repo>/tests)
+    tests_dir: Optional[str] = None
+
+
+def default_crash_config() -> CrashConfig:
+    """The checked-in crashcheck declarations for this repository."""
+    return CrashConfig()

@@ -23,13 +23,15 @@ from metaopt_tpu.analysis.core import Finding, load_paths
 from metaopt_tpu.analysis.durability import check_durability
 from metaopt_tpu.analysis.jax_hygiene import check_jax
 from metaopt_tpu.analysis.locks import LockChecker
-from metaopt_tpu.analysis.registry import (LintConfig, RaceConfig,
-                                           default_config,
+from metaopt_tpu.analysis.registry import (CrashConfig, LintConfig,
+                                           RaceConfig, default_config,
                                            default_race_config)
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 DEFAULT_RACE_BASELINE = os.path.join(os.path.dirname(__file__),
                                      "race_baseline.json")
+DEFAULT_CRASH_BASELINE = os.path.join(os.path.dirname(__file__),
+                                      "crash_baseline.json")
 #: fingerprints embed paths relative to the REPO root (the directory
 #: holding the metaopt_tpu package), never the caller's cwd — the
 #: checked-in baseline must match from anywhere `mtpu lint` is invoked
@@ -275,5 +277,181 @@ def race_main(argv: Optional[Sequence[str]] = None,
                 f"[suites: {', '.join(suites) or 'none'}; "
                 f"{int(stats.get('events', 0))} events in "
                 f"{stats.get('runtime_s', 0.0):.1f}s]")
+        print(("FAIL: " if new else "clean: ") + note)
+    return 1 if new else 0
+
+
+def run_crashcheck(suites: Sequence[str],
+                   cfg: Optional[CrashConfig] = None,
+                   static: bool = True,
+                   paths: Optional[Sequence[str]] = None,
+                   tests_dir: Optional[str] = None
+                   ) -> Tuple[List[Finding], Dict[str, float]]:
+    """Static persistence-order checks (MTP001-MTP004) + exhaustive
+    crash-point enumeration of the durable paths (MTP1xx).
+
+    Returns (findings, stats). Each dynamic suite drives a real durable
+    path under the fsjournal seam, enumerates every legal crash state of
+    its trace, and certifies real recovery against the acked prefix.
+    """
+    from metaopt_tpu.analysis import crashcheck
+    from metaopt_tpu.analysis.registry import default_crash_config
+
+    cfg = cfg or default_crash_config()
+    findings: List[Finding] = []
+    stats: Dict[str, float] = {}
+    t0 = time.monotonic()
+    if static:
+        modules = load_paths(paths or [PKG_DIR], root=REPO_ROOT)
+        findings += crashcheck.check_crash(
+            modules, cfg,
+            tests_dir=tests_dir or os.path.join(REPO_ROOT, "tests"))
+        stats["static_runtime_s"] = round(time.monotonic() - t0, 3)
+    states = 0
+    for name in suites:
+        if name not in crashcheck.SUITES:
+            raise ValueError(
+                f"unknown crashcheck suite {name!r} "
+                f"(have: {', '.join(crashcheck.SUITES)})")
+        suite_findings, suite_stats = crashcheck.run_suite(name)
+        findings += suite_findings
+        states += int(suite_stats.get("crash_states", 0))
+        stats[f"suite_{name}_s"] = suite_stats.get("runtime_s", 0.0)
+    stats["crash_states"] = states
+    stats["runtime_s"] = round(time.monotonic() - t0, 3)
+    findings.sort(key=_sort_key)
+    return findings, stats
+
+
+def crashcheck_main(argv: Optional[Sequence[str]] = None,
+                    cfg: Optional[CrashConfig] = None) -> int:
+    """CLI body shared by ``mtpu crashcheck`` and the tier-1 gate test."""
+    from metaopt_tpu.analysis.crashcheck import SUITES as CRASH_SUITES
+
+    ap = argparse.ArgumentParser(
+        prog="mtpu crashcheck",
+        description="crash-consistency certification: static "
+                    "persistence-order analysis (MTP001 publish order, "
+                    "MTP002 WAL-before-ack, MTP003 durable sequences, "
+                    "MTP004 dead barriers) + exhaustive crash-point "
+                    "enumeration of every durable path with real "
+                    "recovery (MTP1xx)")
+    ap.add_argument("--suite", action="append", default=None,
+                    choices=tuple(CRASH_SUITES) + ("all",),
+                    help="durable path(s) to enumerate (repeatable; "
+                         "default: all)")
+    ap.add_argument("--static-only", action="store_true",
+                    help="run only the MTP static checks, no enumeration")
+    ap.add_argument("--baseline", default=DEFAULT_CRASH_BASELINE,
+                    help="grandfathered-findings file (default: the "
+                         "checked-in analysis/crash_baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this run's findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    suites = args.suite or ["all"]
+    if "all" in suites:
+        suites = list(CRASH_SUITES)
+    if args.static_only:
+        suites = []
+
+    try:
+        findings, stats = run_crashcheck(suites, cfg=cfg)
+    except (OSError, SyntaxError) as e:
+        print(f"mtpu crashcheck: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        # dynamic findings (MTP1xx) are never grandfathered: a
+        # reproducible lost acked write is a bug, not a baseline entry
+        static_only = [f for f in findings
+                       if not f.rule.startswith("MTP1")]
+        save_baseline(args.baseline, static_only)
+        print(f"baseline updated: {len(static_only)} finding(s) -> "
+              f"{args.baseline}")
+        return 1 if len(static_only) != len(findings) else 0
+
+    baseline = Counter() if args.no_baseline else load_baseline(
+        args.baseline)
+    new = diff_baseline(findings, baseline)
+    grandfathered = len(findings) - len(new)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "grandfathered": grandfathered,
+            "stats": stats,
+            "suites": suites,
+            "total": len(findings),
+        }, indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        note = (f"{len(new)} new finding(s), "
+                f"{grandfathered} grandfathered by baseline "
+                f"[suites: {', '.join(suites) or 'none'}; "
+                f"{int(stats.get('crash_states', 0))} crash states in "
+                f"{stats.get('runtime_s', 0.0):.1f}s]")
+        print(("FAIL: " if new else "clean: ") + note)
+    return 1 if new else 0
+
+
+def analyze_main(argv: Optional[Sequence[str]] = None) -> int:
+    """``mtpu analyze``: every static family in one run — lint (MTL lock
+    discipline, MTJ JAX hygiene, MTD durability), race MTR001, and
+    crashcheck MTP001-MTP004 — diffed against the union of the three
+    checked-in baselines, with one combined report."""
+    ap = argparse.ArgumentParser(
+        prog="mtpu analyze",
+        description="umbrella static analysis: lint + race --static-only "
+                    "+ crashcheck --static-only, one combined report")
+    ap.add_argument("paths", nargs="*", default=[PKG_DIR],
+                    help="files/directories to scan (default: the "
+                         "metaopt_tpu package)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baselines")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    t0 = time.monotonic()
+    try:
+        # one run_lint call covers MTL/MTJ/MTD and (via race_cfg) MTR001
+        # over a single parse; crashcheck reuses its own parse because
+        # its effect summaries are package-global
+        lint_findings = run_lint(args.paths, root=REPO_ROOT,
+                                 race_cfg=default_race_config())
+        lint_s = round(time.monotonic() - t0, 3)
+        crash_findings, crash_stats = run_crashcheck(
+            [], paths=args.paths)
+    except (OSError, SyntaxError) as e:
+        print(f"mtpu analyze: {e}", file=sys.stderr)
+        return 2
+
+    findings = sorted(lint_findings + crash_findings, key=_sort_key)
+    baseline: Counter = Counter()
+    if not args.no_baseline:
+        for p in (DEFAULT_BASELINE, DEFAULT_RACE_BASELINE,
+                  DEFAULT_CRASH_BASELINE):
+            baseline += load_baseline(p)
+    new = diff_baseline(findings, baseline)
+    grandfathered = len(findings) - len(new)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "grandfathered": grandfathered,
+            "lint_runtime_s": lint_s,
+            "crashcheck_runtime_s": crash_stats.get("runtime_s", 0.0),
+            "total": len(findings),
+        }, indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        note = (f"{len(new)} new finding(s), "
+                f"{grandfathered} grandfathered across "
+                "lint+race+crashcheck baselines")
         print(("FAIL: " if new else "clean: ") + note)
     return 1 if new else 0

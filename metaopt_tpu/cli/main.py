@@ -21,6 +21,7 @@ from metaopt_tpu.io.resolve_config import resolve_config
 from metaopt_tpu.ledger import Experiment, Trial
 from metaopt_tpu.ledger.backends import make_ledger
 from metaopt_tpu.space import SpaceBuilder
+from metaopt_tpu.utils.fsjournal import fsync_dir
 from metaopt_tpu.worker import workon
 
 log = logging.getLogger(__name__)
@@ -468,6 +469,43 @@ def build_parser() -> argparse.ArgumentParser:
                       help="report every finding, ignore the baseline")
     race.add_argument("--format", choices=("text", "json"),
                       default="text", dest="race_format")
+
+    crash = sub.add_parser(
+        "crashcheck",
+        help="crash-consistency certification: static persistence-order "
+             "analysis plus exhaustive crash-point enumeration of every "
+             "durable path with real recovery",
+    )
+    crash.add_argument("--suite", action="append", default=None,
+                       choices=("wal", "snapshot", "archive", "evict",
+                                "handoff", "all"),
+                       help="durable path(s) to enumerate (repeatable; "
+                            "default: all)")
+    crash.add_argument("--static-only", action="store_true",
+                       help="run only the MTP static checks, no "
+                            "enumeration")
+    crash.add_argument("--baseline", default=None,
+                       help="grandfathered-findings file (default: the "
+                            "checked-in analysis/crash_baseline.json)")
+    crash.add_argument("--update-baseline", action="store_true")
+    crash.add_argument("--no-baseline", action="store_true",
+                       help="report every finding, ignore the baseline")
+    crash.add_argument("--format", choices=("text", "json"),
+                       default="text", dest="crash_format")
+
+    analyze = sub.add_parser(
+        "analyze",
+        help="umbrella static analysis: lint + race --static-only + "
+             "crashcheck --static-only, one combined report",
+    )
+    analyze.add_argument("paths", nargs="*", default=[],
+                         help="files/directories to scan (default: the "
+                              "metaopt_tpu package, from any cwd)")
+    analyze.add_argument("--no-baseline", action="store_true",
+                         help="report every finding, ignore the "
+                              "baselines")
+    analyze.add_argument("--format", choices=("text", "json"),
+                         default="text", dest="analyze_format")
 
     return p
 
@@ -1428,7 +1466,10 @@ def _db_dump(args, ledger) -> int:
         tmp = args.output + ".tmp"
         with open(tmp, "w") as f:
             f.write(text)
-        os.replace(tmp, args.output)  # atomic: never a torn archive
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, args.output)  # atomic AND durable: never a torn
+        fsync_dir(args.output)        # archive, even across power loss
         n_trials = sum(len(e["trials"]) for e in experiments)
         print(f"dumped {len(experiments)} experiment(s), {n_trials} "
               f"trial(s) to {args.output}")
@@ -2176,10 +2217,40 @@ def _cmd_race(args: argparse.Namespace, cfg: Dict[str, Any]) -> int:
     return race_main(argv)
 
 
+def _cmd_crashcheck(args: argparse.Namespace, cfg: Dict[str, Any]) -> int:
+    from metaopt_tpu.analysis.runner import crashcheck_main
+
+    argv: List[str] = []
+    for s in args.suite or []:
+        argv += ["--suite", s]
+    if args.static_only:
+        argv.append("--static-only")
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.update_baseline:
+        argv.append("--update-baseline")
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    argv += ["--format", args.crash_format]
+    return crashcheck_main(argv)
+
+
+def _cmd_analyze(args: argparse.Namespace, cfg: Dict[str, Any]) -> int:
+    from metaopt_tpu.analysis.runner import analyze_main
+
+    argv: List[str] = list(args.paths or [])
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    argv += ["--format", args.analyze_format]
+    return analyze_main(argv)
+
+
 _COMMANDS = {
     "hunt": _cmd_hunt,
     "lint": _cmd_lint,
     "race": _cmd_race,
+    "crashcheck": _cmd_crashcheck,
+    "analyze": _cmd_analyze,
     "benchmark": _cmd_benchmark,
     "init-only": _cmd_init_only,
     "insert": _cmd_insert,
@@ -2210,7 +2281,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         ):
             args.assignments = list(getattr(args, "assignments", None) or [])
             args.assignments += extras
-        elif getattr(args, "command", None) == "lint" and all(
+        elif getattr(args, "command", None) in ("lint", "analyze") and all(
             not e.startswith("-") for e in extras
         ):
             # same 3.10 nargs="*" quirk for `lint --format json PATH`

@@ -232,6 +232,37 @@ def migrate_experiment(
 # offline recovery — the failover half
 # ---------------------------------------------------------------------------
 
+def _inflate_v2_readonly(path: str, state: Dict[str, Any]) -> None:
+    """Expand a v2 (incremental) manifest in place to the v1 shape
+    ``recover_shard_state`` reads: ``experiments`` + ``trials`` built from
+    each section's mutable docs plus its referenced segment files, the
+    per-segment ``dead`` lists filtering revived rows out. Read-only twin
+    of ``CoordServer._inflate_v2`` — it must never truncate or repair the
+    dead shard's files."""
+    seg_dir = path + ".segments"
+    experiments: Dict[str, Any] = {}
+    trials: Dict[str, Any] = {}
+    for name, sec in (state.get("sections") or {}).items():
+        experiments[name] = sec.get("experiment")
+        docs = list(sec.get("docs") or [])
+        for entry in sec.get("segments") or []:
+            fp = os.path.join(seg_dir, entry["file"])
+            try:
+                with open(fp) as sf:
+                    seg_state = json.load(sf)
+            except (OSError, ValueError):
+                log.error("failover: segment file %s unreadable; its rows "
+                          "are lost to this recovery", fp)
+                continue
+            dead = set(entry.get("dead") or ())
+            docs.extend(
+                d for i, d in enumerate(seg_state.get("docs") or [])
+                if i not in dead)
+        trials[name] = docs
+    state["experiments"] = experiments
+    state["trials"] = trials
+
+
 def recover_shard_state(
     snapshot_path: Optional[str],
     wal_path: Optional[str],
@@ -252,6 +283,33 @@ def recover_shard_state(
     trials: Dict[str, Dict[str, Dict[str, Any]]] = {}
     signals: Dict[Tuple[str, str], str] = {}
     replies: Dict[str, Tuple[str, Dict[str, Any]]] = {}  # req → (exp, reply)
+
+    def _apply_evict_file(name: str, path: Optional[str]) -> None:
+        """Merge one evict file (the full state _evict_fenced captured)
+        into the recovery — read-only, captured-state-wins over anything
+        journaled BEFORE it (callers invoke this in seq order, so records
+        after the evict/hydrate still override below)."""
+        if not path or not os.path.exists(path):
+            log.error("failover: evict file %r missing; experiment %r "
+                      "recovers without its evicted state", path, name)
+            return
+        try:
+            with open(path) as f:
+                st = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            log.exception("failover: evict file %s unreadable; experiment "
+                          "%r recovers without its evicted state", path,
+                          name)
+            return
+        if st.get("experiment") is not None:
+            experiments[name] = st["experiment"]
+        for doc in st.get("trials") or []:
+            trials.setdefault(name, {})[doc["id"]] = doc
+        for sig in st.get("signals") or []:
+            signals[(name, sig["trial_id"])] = sig["signal"]
+        for r in st.get("replies") or []:
+            replies[r["req"]] = (name, r["reply"])
+
     snap_seq = 0
     if snapshot_path and os.path.exists(snapshot_path):
         try:
@@ -262,12 +320,24 @@ def recover_shard_state(
                           "from WAL alone)", snapshot_path)
             snap = {}
         snap_seq = int(snap.get("wal_seq", 0) or 0)
+        if int(snap.get("version", 1) or 1) >= 2:
+            # v2 (incremental) manifest: inflate sections + referenced
+            # segment files to the v1 shape, read-only (mirrors
+            # CoordServer._inflate_v2 — a torn segment file loses only
+            # its own rows, never the rest of the manifest)
+            _inflate_v2_readonly(snapshot_path, snap)
         for name, cfg in (snap.get("experiments") or {}).items():
             experiments[name] = cfg
         for name, docs in (snap.get("trials") or {}).items():
             trials[name] = {d["id"]: d for d in docs}
         for sig in snap.get("signals") or []:
             signals[(sig["experiment"], sig["trial"])] = sig["signal"]
+        for name, stub in (snap.get("evicted") or {}).items():
+            # an evicted experiment's docs live ONLY in its evict file
+            # once the WAL is compacted — skipping the stub loses every
+            # acked write the file holds
+            if name not in experiments:
+                _apply_evict_file(name, (stub or {}).get("path"))
 
     def _upsert(doc: Dict[str, Any]) -> None:
         exp = doc.get("experiment")
@@ -280,9 +350,20 @@ def recover_shard_state(
             log.warning("failover: %d torn bytes at the tail of %s "
                         "skipped (never acknowledged)", torn, wal_path)
         for rec in records:
-            if int(rec.get("seq", 0)) <= snap_seq:
-                continue
             op = rec.get("op")
+            if int(rec.get("seq", 0)) <= snap_seq:
+                # records at or below the snapshot bound survive on disk
+                # only in the window between a snapshot publish and its
+                # compaction finishing. The snapshot does NOT carry the
+                # reply cache, so a reply record must still install its
+                # cache entry (exactly-once across a crash inside that
+                # window). Its embedded doc is already reflected by the
+                # snapshot — and may be STALER than it — so only the
+                # cache entry is taken.
+                if op == "reply" and rec.get("exp"):
+                    replies[rec["req"]] = (rec["exp"],
+                                           rec.get("reply") or {})
+                continue
             if op == "put_trial":
                 _upsert(rec["trial"])
             elif op == "create_experiment":
@@ -302,6 +383,12 @@ def recover_shard_state(
             elif op == "set_signal":
                 signals[(rec["experiment"], rec["trial_id"])] = (
                     rec["signal"])
+            elif op in ("evict", "hydrate"):
+                # both record kinds point at the evict file that froze
+                # the experiment's full state at evict time; merging it
+                # here (captured-state-wins, later records re-override)
+                # matches what _apply_wal_record replays live
+                _apply_evict_file(rec["experiment"], rec.get("path"))
             elif op == "reply":
                 reply = rec.get("reply") or {}
                 exp = rec.get("exp")

@@ -99,6 +99,7 @@ from metaopt_tpu.ledger.backends import (
     MemoryLedger,
 )
 from metaopt_tpu.ledger.trial import Trial
+from metaopt_tpu.utils import fsjournal as fsj
 from metaopt_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 log = logging.getLogger(__name__)
@@ -807,6 +808,16 @@ class CoordServer:
                     seq = int(rec.get("seq", 0))
                     last_seq = max(last_seq, seq)
                     if seq <= snap_seq:
+                        # pre-bound records exist on disk only in the
+                        # window between a snapshot publish and its
+                        # compaction; the snapshot carries no reply
+                        # cache, so the reply entry must still be
+                        # installed (exactly-once across a crash in
+                        # that window). The embedded doc is skipped:
+                        # the snapshot's copy can only be newer.
+                        if rec.get("op") == "reply":
+                            self._cache_reply(rec["req"], rec["reply"],
+                                              exp=rec.get("exp"))
                         continue
                     try:
                         touched = self._apply_wal_record(rec)
@@ -1163,11 +1174,11 @@ class CoordServer:
         os.makedirs(seg_dir, exist_ok=True)
         tmp = os.path.join(seg_dir, fname + ".tmp")
         final = os.path.join(seg_dir, fname)
-        with open(tmp, "w") as f:
-            json.dump({"experiment": name, "seg": seg_id, "docs": docs}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, final)
+        # written + fsynced BEFORE the rename publishes it (crash-atomic
+        # doctrine); the seam records the effect trace under crashcheck
+        fsj.write_file(tmp, json.dumps(
+            {"experiment": name, "seg": seg_id, "docs": docs}).encode())
+        fsj.replace(tmp, final)
         fsync_dir(final)
         self._seg_on_disk[seg_id] = fname
         if faults.fire("crash_segment_seal"):
@@ -1209,30 +1220,26 @@ class CoordServer:
                          wal, wal_seq: int) -> None:
         tmp = path + ".tmp"
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-            # flush + fsync BEFORE the rename: os.replace orders the
-            # metadata, not the data blocks — on power loss the rename
-            # could land pointing at an unwritten file, destroying the
-            # previous good snapshot too
-            f.flush()
-            if faults.fire("partial_snapshot"):
-                # chaos: die mid-snapshot — a truncated tmp on disk,
-                # the previous snapshot and the (un-compacted) WAL
-                # intact. Recovery must ignore the torn tmp entirely.
-                f.truncate(max(1, f.tell() // 2))
-                f.flush()
-                os.fsync(f.fileno())
-                os.kill(os.getpid(), _signal_mod.SIGKILL)
-            os.fsync(f.fileno())
-            if faults.fire("crash_manifest_commit"):
-                # chaos: die with the tmp manifest fully durable but the
-                # rename not yet issued — recovery must come up on the
-                # PREVIOUS manifest plus the (un-compacted) WAL; newly
-                # sealed segment files are unreferenced orphans until a
-                # post-recovery snapshot collects them
-                os.kill(os.getpid(), _signal_mod.SIGKILL)
-        os.replace(tmp, path)
+        payload = json.dumps(state).encode()
+        if faults.fire("partial_snapshot"):
+            # chaos: die mid-snapshot — a truncated tmp on disk,
+            # the previous snapshot and the (un-compacted) WAL
+            # intact. Recovery must ignore the torn tmp entirely.
+            fsj.write_file(tmp, payload[: max(1, len(payload) // 2)])
+            os.kill(os.getpid(), _signal_mod.SIGKILL)
+        # write + flush + fsync BEFORE the rename: os.replace orders the
+        # metadata, not the data blocks — on power loss the rename
+        # could land pointing at an unwritten file, destroying the
+        # previous good snapshot too
+        fsj.write_file(tmp, payload)
+        if faults.fire("crash_manifest_commit"):
+            # chaos: die with the tmp manifest fully durable but the
+            # rename not yet issued — recovery must come up on the
+            # PREVIOUS manifest plus the (un-compacted) WAL; newly
+            # sealed segment files are unreferenced orphans until a
+            # post-recovery snapshot collects them
+            os.kill(os.getpid(), _signal_mod.SIGKILL)
+        fsj.replace(tmp, path)
         fsync_dir(path)
         if wal is not None:
             # everything <= wal_seq is now durably in the snapshot;
@@ -1262,7 +1269,7 @@ class CoordServer:
             # crash_segment_seal / crash_manifest_commit windows, and
             # torn .tmp files all land here
             try:
-                os.remove(os.path.join(seg_dir, fname))
+                fsj.unlink(os.path.join(seg_dir, fname))
             except OSError:
                 pass
         for seg_id, fname in list(self._seg_on_disk.items()):
@@ -1478,13 +1485,10 @@ class CoordServer:
         path = self._evict_file(name)
         os.makedirs(self.evict_dir, exist_ok=True)
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-            # fsync BEFORE the rename — same crash-atomic doctrine as the
-            # snapshot writer: the rename must never land on unwritten data
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        # fsync BEFORE the rename — same crash-atomic doctrine as the
+        # snapshot writer: the rename must never land on unwritten data
+        fsj.write_file(tmp, json.dumps(state).encode())
+        fsj.replace(tmp, path)
         fsync_dir(path)
         if faults.fire("crash_evict"):
             # chaos barrier 1: file durable, nothing journaled, nothing

@@ -64,6 +64,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from metaopt_tpu.coord.protocol import (HAVE_WIRE_V2, ProtocolError,
                                         decode_body, encode_body)
+from metaopt_tpu.utils import fsjournal as fsj
+# re-exported: server.py and the snapshot/evict publishers import it from
+# here; the implementation lives in the FS seam so every directory fsync
+# lands in a recorded effect trace under `mtpu crashcheck`
+from metaopt_tpu.utils.fsjournal import fsync_dir  # noqa: F401
 from metaopt_tpu.utils.clock import SYSTEM_CLOCK, Clock
 
 log = logging.getLogger(__name__)
@@ -152,10 +157,7 @@ def read_records(path: str, truncate_torn: bool = True
     if torn and truncate_torn:
         log.warning("WAL %s: torn tail (%d bytes after record %d) truncated",
                     path, torn, records[-1].get("seq", 0) if records else 0)
-        with open(path, "r+b") as f:
-            f.truncate(good_end)
-            f.flush()
-            os.fsync(f.fileno())
+        fsj.truncate(path, good_end)
     return records, torn
 
 
@@ -186,19 +188,6 @@ def record_experiment(rec: Dict[str, Any]) -> Optional[str]:
     if op == "reply":
         return rec.get("exp")
     return None
-
-
-def fsync_dir(path: str) -> None:
-    """fsync the parent directory so a rename/creat is itself durable."""
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    try:
-        fd = os.open(d, os.O_RDONLY)
-    except OSError:
-        return  # platform without directory fds — best effort
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
 
 
 class WriteAheadLog:
@@ -378,10 +367,7 @@ class WriteAheadLog:
             self._f.flush()
             os.fsync(self._f.fileno())
             os.kill(os.getpid(), signal.SIGKILL)
-        self._f.write(data)
-        self._f.flush()
-        if self.fsync:
-            os.fsync(self._f.fileno())
+        fsj.append(self._f, self.path, data, fsync=self.fsync)
         # counters are read by stats()/bench from other threads; the
         # lock is taken AFTER the I/O so fsync never runs under it
         with self._buf_lock:
@@ -507,14 +493,16 @@ class WriteAheadLog:
             records, _ = read_records(self.path, truncate_torn=False)
             tail = [r for r in records if r.get("seq", 0) > upto_seq]
             tmp = self.path + ".tmp"
-            with open(tmp, "wb") as f:
-                # rewritten in the log's own framing: compaction after an
-                # upgrade is what migrates a mixed v1/v2 log to pure v2
-                for r in tail:
-                    f.write(self._frame_rec(r))
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
+            # rewritten in the log's own framing: compaction after an
+            # upgrade is what migrates a mixed v1/v2 log to pure v2.
+            # tmp is written + fsynced BEFORE the rename publishes it
+            # (crash-atomic doctrine — MTP001).
+            fsj.write_file(tmp, b"".join(self._frame_rec(r) for r in tail))
+            # the marker precedes the rename: from the next effect on, a
+            # crash state may legitimately lack records <= upto_seq (the
+            # certifier must excuse them one event EARLY, never late)
+            fsj.mark("wal_compacted", upto=upto_seq)
+            fsj.replace(tmp, self.path)
             fsync_dir(self.path)
             try:
                 self._f.close()

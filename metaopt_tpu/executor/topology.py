@@ -172,7 +172,10 @@ class ChipRegistry:
                 tmp = self.state_path + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(state, f)
-                os.replace(tmp, self.state_path)
+                # atomic, deliberately not durable: claims are leases —
+                # a power-lost registry is healed by _reap() on the next
+                # flock'd read (stale heartbeats expire the claims)
+                os.replace(tmp, self.state_path)  # mtpu: lint-ok MTP001 lease state, heal-on-read
                 return result
             finally:
                 fcntl.flock(lockf, fcntl.LOCK_UN)

@@ -695,7 +695,10 @@ class FileLedger(LedgerBackend):
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
-        os.replace(tmp, path)
+        # atomic, deliberately not durable: FileLedger's documented
+        # contract is torn-free reads, with the coordinator WAL owning
+        # durability — doubling fsyncs here would tax every trial write
+        os.replace(tmp, path)  # mtpu: lint-ok MTP001 WAL owns durability
 
     @staticmethod
     def _read_json(path: str) -> Optional[Dict[str, Any]]:

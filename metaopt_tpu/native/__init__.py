@@ -42,7 +42,10 @@ def _compile() -> bool:
         if proc.returncode != 0:
             log.warning("ledgerstore build failed:\n%s", proc.stderr[-2000:])
             return False
-        os.replace(tmp, _SO)
+        # atomic, deliberately not durable: the .so is a rebuildable
+        # compile cache — a torn loss after power failure just costs one
+        # recompile on next import
+        os.replace(tmp, _SO)  # mtpu: lint-ok MTP001 rebuildable cache
         return True
     except (OSError, subprocess.TimeoutExpired) as e:
         log.info("ledgerstore build unavailable: %s", e)

@@ -10,6 +10,7 @@ each protocol barrier lives in tests/functional/test_coord_handoff_chaos.py.
 """
 
 import json
+import os
 import socket
 import threading
 import time
@@ -418,6 +419,116 @@ class TestOfflineRecovery:
     def test_recover_missing_files_is_empty(self, tmp_path):
         assert recover_shard_state(str(tmp_path / "no.snap"),
                                    str(tmp_path / "no.wal")) == {}
+
+    def test_prebound_reply_window_installs_cache_entry_only(self, tmp_path):
+        """Regression (ISSUE 19, found by the crashcheck suites): a crash
+        in the window between a snapshot publish and its compaction
+        finishing leaves acked reply records AT OR BELOW the snapshot's
+        WAL bound on disk. The snapshot carries no reply cache, so the
+        replay must still install those cache entries — but must NOT
+        replay their embedded docs, which the snapshot supersedes."""
+        wal_path = str(tmp_path / "shard.wal")
+        wal = WriteAheadLog(wal_path, fsync=False).open()
+        try:
+            # seq 1: an acked reserve's reply, embedding the doc in its
+            # then-current (reserved) state — STALER than the snapshot
+            wal.append({"op": "reply", "req": "r-res", "exp": "exp-a",
+                        "reply": {"ok": True, "result": {
+                            "id": "t1", "experiment": "exp-a",
+                            "params": {"x": 1}, "status": "reserved"}}})
+            # seq 2: a stale put_trial, also below the bound
+            wal.append({"op": "put_trial",
+                        "trial": {"id": "t1", "experiment": "exp-a",
+                                  "status": "reserved",
+                                  "params": {"x": 1}}})
+            wal.sync(wal.appended_seq)
+        finally:
+            wal.close()
+        snap_path = str(tmp_path / "shard.snap")
+        with open(snap_path, "w") as f:
+            json.dump({"wal_seq": 10,
+                       "experiments": {"exp-a": {"name": "exp-a"}},
+                       "trials": {"exp-a": [
+                           {"id": "t1", "experiment": "exp-a",
+                            "status": "completed", "objective": 2.0,
+                            "params": {"x": 1}}]},
+                       "signals": []}, f)
+        state = recover_shard_state(snap_path, wal_path)
+        s = state["exp-a"]
+        # the acked reply survived the window ...
+        assert s["replies"] == [
+            {"req": "r-res", "reply": {"ok": True, "result": {
+                "id": "t1", "experiment": "exp-a",
+                "params": {"x": 1}, "status": "reserved"}}}]
+        # ... and neither pre-bound record regressed the snapshot's doc
+        assert [t["status"] for t in s["trials"]] == ["completed"]
+        assert s["trials"][0]["objective"] == 2.0
+
+    def test_recover_inflates_v2_manifest_readonly(self, tmp_path):
+        snap_path = str(tmp_path / "shard.snap")
+        seg_dir = snap_path + ".segments"
+        os.makedirs(seg_dir)
+        with open(os.path.join(seg_dir, "seg-0.json"), "w") as f:
+            json.dump({"docs": [
+                {"id": "t1", "experiment": "exp-v", "status": "completed"},
+                {"id": "t2", "experiment": "exp-v", "status": "completed"},
+            ]}, f)
+        with open(snap_path, "w") as f:
+            json.dump({"version": 2, "wal_seq": 3, "sections": {
+                "exp-v": {"experiment": {"name": "exp-v"},
+                          "docs": [{"id": "t3", "experiment": "exp-v",
+                                    "status": "reserved"}],
+                          "segments": [{"file": "seg-0.json",
+                                        "dead": [1]}]}},
+                "signals": []}, f)
+        before = os.path.getsize(snap_path)
+        state = recover_shard_state(snap_path, None)
+        # mutable docs + segment rows, minus the dead index
+        assert {t["id"] for t in state["exp-v"]["trials"]} == {"t1", "t3"}
+        assert os.path.getsize(snap_path) == before  # post-mortem = read
+
+    def test_recover_merges_evicted_stub_from_evict_file(self, tmp_path):
+        evict_path = str(tmp_path / "exp-e.evict")
+        with open(evict_path, "w") as f:
+            json.dump({"experiment": {"name": "exp-e"},
+                       "trials": [{"id": "e1", "experiment": "exp-e",
+                                   "status": "completed"}],
+                       "signals": [{"trial_id": "e1", "signal": "stop"}],
+                       "replies": [{"req": "r-e",
+                                    "reply": {"ok": True}}]}, f)
+        snap_path = str(tmp_path / "shard.snap")
+        with open(snap_path, "w") as f:
+            json.dump({"wal_seq": 1, "experiments": {},
+                       "evicted": {"exp-e": {"path": evict_path}}}, f)
+        state = recover_shard_state(snap_path, None)
+        s = state["exp-e"]
+        assert [t["id"] for t in s["trials"]] == ["e1"]
+        assert s["signals"] == [{"trial_id": "e1", "signal": "stop"}]
+        assert s["replies"] == [{"req": "r-e", "reply": {"ok": True}}]
+
+    def test_recover_replays_evict_record_then_overrides(self, tmp_path):
+        """An evict record in the WAL tail merges the evict file's frozen
+        state; records journaled AFTER it still win (the live replay
+        order)."""
+        evict_path = str(tmp_path / "exp-w.evict")
+        with open(evict_path, "w") as f:
+            json.dump({"experiment": {"name": "exp-w"},
+                       "trials": [{"id": "w1", "experiment": "exp-w",
+                                   "status": "reserved"}],
+                       "signals": [], "replies": []}, f)
+        wal_path = str(tmp_path / "shard.wal")
+        wal = WriteAheadLog(wal_path, fsync=False).open()
+        try:
+            wal.append({"op": "evict", "experiment": "exp-w",
+                        "path": evict_path})
+            wal.append({"op": "put_trial",
+                        "trial": {"id": "w1", "experiment": "exp-w",
+                                  "status": "completed"}})
+            wal.sync(wal.appended_seq)
+        finally:
+            wal.close()
+        state = recover_shard_state(None, wal_path)
+        assert state["exp-w"]["trials"][0]["status"] == "completed"
 
 
 class TestFailover:

@@ -1,0 +1,261 @@
+"""Unit coverage for the MTP checker family and the fsjournal seam
+(ISSUE 19).
+
+The ``bad_*`` fixtures under ``crashcheck_fixtures/`` are fix-reverted
+copies of real bugs this repo fixed (the pre-fix ``mtpu db dump``
+publish, an ack-before-sync sender, a record-after-drop evict): each
+must be rediscovered deterministically, and its ``good_*`` twin must be
+clean. The dynamic half is covered here at the seam level (every
+byte-level cut of a mixed v1/v2 WAL tail) and end-to-end by
+test_crashcheck_clean.py's tier-1 gate.
+"""
+
+import os
+import textwrap
+
+from metaopt_tpu.analysis.core import load_paths
+from metaopt_tpu.analysis.crashcheck import (
+    SUITES, check_crash, load_durable_sequences, run_suite)
+from metaopt_tpu.analysis.registry import CrashConfig, default_crash_config
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "crashcheck_fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: the fixture-local twin of protocol.DURABLE_SEQUENCES' "evict" entry
+_EVICT_SEQ = {
+    "evict": {
+        "function": "Server.evict",
+        "steps": ["publish:.tmp", "wal.append:evict", "wal.sync",
+                  "call:delete_experiment"],
+        "optional": [3],
+    },
+}
+
+
+def _run(fname, **cfg_kw):
+    mods = load_paths([os.path.join(FIXTURES, fname)], root=FIXTURES)
+    cfg = CrashConfig(**cfg_kw) if cfg_kw else default_crash_config()
+    return check_crash(mods, cfg)
+
+
+class TestMTP001PublishOrder:
+    def test_fix_reverted_db_dump_publish_rediscovered(self):
+        findings = _run("bad_publish_no_fsync.py")
+        assert {f.rule for f in findings} == {"MTP001"}
+        details = sorted(f.detail.split("|", 1)[0] for f in findings)
+        assert details == ["nodirfsync", "nofsync"]
+        assert all(f.symbol == "dump_archive" for f in findings)
+
+    def test_rediscovery_is_deterministic(self):
+        first = [(f.rule, f.line, f.detail)
+                 for f in _run("bad_publish_no_fsync.py")]
+        for _ in range(3):
+            again = [(f.rule, f.line, f.detail)
+                     for f in _run("bad_publish_no_fsync.py")]
+            assert again == first
+
+    def test_good_publish_clean_raw_seam_and_helpers(self):
+        assert _run("good_publish.py") == []
+
+    def test_pragma_suppresses_with_doctrine(self, tmp_path):
+        src = textwrap.dedent("""\
+            import os
+
+            def publish(path, text):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(text)
+                os.replace(tmp, path)  # mtpu: lint-ok MTP001 rebuildable
+        """)
+        (tmp_path / "mod.py").write_text(src)
+        mods = load_paths([str(tmp_path / "mod.py")], root=str(tmp_path))
+        assert check_crash(mods, default_crash_config()) == []
+
+
+class TestMTP002WalBeforeAck:
+    def test_fix_reverted_ack_before_sync_rediscovered(self):
+        findings = _run("bad_ack_before_sync.py")
+        assert [f.rule for f in findings] == ["MTP002"]
+        assert findings[0].symbol == "CoordServer._serve_conn._sender"
+        assert "send_payload" in findings[0].detail
+
+    def test_sync_before_send_clean(self):
+        assert _run("good_ack_after_sync.py") == []
+
+    def test_scope_limited_to_ack_publishers(self):
+        # the same send, outside any ack-publisher scope, is not flagged
+        findings = _run("bad_ack_before_sync.py",
+                        ack_publishers={"Nowhere._nothing"})
+        assert findings == []
+
+
+class TestMTP003DurableSequences:
+    def test_fix_reverted_record_after_drop_rediscovered(self):
+        findings = _run("bad_sequence_reorder.py",
+                        durable_sequences=_EVICT_SEQ)
+        assert [f.rule for f in findings] == ["MTP003"]
+        assert "delete_experiment" in findings[0].detail
+        assert "wal.append:evict" in findings[0].message
+
+    def test_skipping_path_flagged_despite_good_sibling_path(self):
+        findings = _run("bad_sequence_skip.py",
+                        durable_sequences=_EVICT_SEQ)
+        assert [f.rule for f in findings] == ["MTP003"]
+
+    def test_prefix_abort_and_wal_guard_are_legal(self):
+        assert _run("good_sequence.py",
+                    durable_sequences=_EVICT_SEQ) == []
+
+    def test_registry_read_as_literal_from_protocol(self):
+        mods = load_paths([os.path.join(REPO, "metaopt_tpu", "coord",
+                                        "protocol.py")], root=REPO)
+        seqs = load_durable_sequences(mods, default_crash_config())
+        assert {"evict", "archive_seal", "snapshot_commit"} <= set(seqs)
+        for entry in seqs.values():
+            assert entry["function"].startswith("CoordServer.")
+            assert entry["steps"]
+
+    def test_real_durable_paths_clean(self):
+        # the live evict/archive/snapshot protocols satisfy their own
+        # registry entries (plus every other MTP rule) with no pragmas
+        # beyond the documented atomicity-only publishes
+        mods = load_paths([os.path.join(REPO, "metaopt_tpu")], root=REPO)
+        findings = check_crash(mods, default_crash_config())
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+class TestMTP004DeadBarriers:
+    def _mod(self, tmp_path, body):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(body)
+        return load_paths([str(pkg)], root=str(tmp_path))
+
+    def test_unarmed_barrier_flagged(self, tmp_path):
+        mods = self._mod(tmp_path, "def f():\n"
+                         "    if faults.fire('never_armed'):\n"
+                         "        pass\n")
+        tests = tmp_path / "tests"
+        tests.mkdir()
+        (tests / "test_x.py").write_text("def test_ok():\n    pass\n")
+        findings = check_crash(mods, default_crash_config(),
+                               tests_dir=str(tests))
+        assert [f.rule for f in findings] == ["MTP004"]
+        assert findings[0].detail == "never_armed"
+
+    def test_literal_arming_in_tests_clears_it(self, tmp_path):
+        mods = self._mod(tmp_path, "def f():\n"
+                         "    if faults.fire('crash_x'):\n"
+                         "        pass\n")
+        tests = tmp_path / "tests"
+        tests.mkdir()
+        (tests / "test_x.py").write_text(
+            "def test_arm():\n    arm('crash_x:1')\n")
+        assert check_crash(mods, default_crash_config(),
+                           tests_dir=str(tests)) == []
+
+    def test_faults_constant_indirection_arms_transitively(self, tmp_path):
+        # the sim_delay pattern: the kind never appears in tests, but a
+        # *FAULTS* constant naming it is imported by one
+        mods = self._mod(
+            tmp_path,
+            "DEFAULT_FAULTS = 'crash_y:p=0.1@1,crash_z:2@4'\n\n"
+            "def f(self):\n"
+            "    if self.faults.fire('crash_z'):\n"
+            "        pass\n")
+        tests = tmp_path / "tests"
+        tests.mkdir()
+        (tests / "test_x.py").write_text(
+            "from pkg.mod import DEFAULT_FAULTS\n")
+        assert check_crash(mods, default_crash_config(),
+                           tests_dir=str(tests)) == []
+
+    def test_every_real_barrier_is_armed(self):
+        # the MTP004 audit over the real tree: no dead chaos code
+        mods = load_paths([os.path.join(REPO, "metaopt_tpu")], root=REPO)
+        findings = [f for f in check_crash(
+            mods, default_crash_config(),
+            tests_dir=os.path.join(REPO, "tests"))
+            if f.rule == "MTP004"]
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+class TestFsjournalEnumeration:
+    def test_every_byte_cut_enumerated(self, tmp_path):
+        from metaopt_tpu.utils import fsjournal as fsj
+        root = str(tmp_path)
+        with fsj.recording(root) as journal:
+            fsj.write_file(os.path.join(root, "a"), b"abcdef")
+        events = journal.snapshot()
+        states = list(fsj.enumerate_crash_states(events, torn_cuts=None))
+        # prefixes: before anything, after write, after fsync — plus a
+        # torn state for EVERY proper prefix of the 6-byte write
+        cuts = [s for s in states if "+" in s[0]]
+        assert len(cuts) == 5
+        torn_contents = sorted(s[2]["a"] for s in cuts)
+        assert torn_contents == [b"a", b"ab", b"abc", b"abcd", b"abcde"]
+
+    def test_mixed_v1_v2_torn_tail_through_seam(self, tmp_path):
+        from metaopt_tpu.coord.wal import (HAVE_WIRE_V2, WriteAheadLog,
+                                           read_records)
+        from metaopt_tpu.utils import fsjournal as fsj
+        root = str(tmp_path / "w")
+        os.makedirs(root)
+        path = os.path.join(root, "log.wal")
+        with fsj.recording(root) as journal:
+            wal = WriteAheadLog(path, group_window_s=0.0).open()
+            acked = []
+            for i in range(2):
+                seq = wal.append({"op": "set_signal", "experiment": "e",
+                                  "trial_id": f"t{i}", "signal": "stop"})
+                wal.sync(seq)
+                acked.append(seq)
+            # a >64-bit int forces the v1 fallback frame mid-log
+            seq = wal.append({"op": "x", "n": 1 << 70})
+            wal.sync(seq)
+            acked.append(seq)
+            wal.close()
+            events = journal.snapshot()
+        if HAVE_WIRE_V2:
+            with open(path, "rb") as f:
+                data = f.read()
+            assert data.startswith(b"W2")     # v2 framing leads
+            assert b"\n" in data              # v1 fallback line present
+        synced_at = {}  # event index -> acked seqs so far
+        n = 0
+        for e in events:
+            if e["kind"] == "fsync":
+                n += 1
+            synced_at[len(synced_at)] = n
+        for label, upto, files in fsj.enumerate_crash_states(
+                events, torn_cuts=None):
+            for rel, blob in files.items():
+                full = os.path.join(root, rel)
+                os.makedirs(os.path.dirname(full), exist_ok=True)
+                with open(full, "wb") as f:
+                    f.write(blob)
+            if "log.wal" not in files:
+                if os.path.exists(path):
+                    os.unlink(path)
+                continue
+            recs, _torn = read_records(path, truncate_torn=True)
+            got = {r.get("seq") for r in recs}
+            fsyncs = sum(1 for e in events[:upto] if e["kind"] == "fsync")
+            for seq in acked[:fsyncs]:
+                assert seq in got, (
+                    f"state {label}: synced seq {seq} lost")
+            recs2, torn2 = read_records(path, truncate_torn=True)
+            assert torn2 == 0
+            assert [r.get("seq") for r in recs2] == \
+                [r.get("seq") for r in recs]
+
+    def test_wal_suite_enumerates_beyond_prefixes(self):
+        findings, stats = run_suite("wal")
+        assert findings == [], "\n".join(f.render() for f in findings)
+        # byte-level cuts dominate: far more states than trace events
+        assert stats["crash_states"] > 10 * stats["events"]
+
+    def test_all_suites_exist(self):
+        assert set(SUITES) == {"wal", "snapshot", "archive", "evict",
+                               "handoff"}

@@ -1,0 +1,80 @@
+"""Tier-1 CI gate: ``mtpu crashcheck --suite all`` must certify every
+durable path with ZERO unbaselined findings (ISSUE 19).
+
+Static (MTP001-004) runs over the whole package against
+metaopt_tpu/analysis/crash_baseline.json — currently EMPTY: every
+rename-publish either follows the full tmp→flush→fsync→rename→dir-fsync
+doctrine or carries an explicit atomicity-only pragma with its
+justification inline. Dynamic (MTP101-103) enumerates every legal crash
+state of the five durable-path traces and is NEVER grandfathered: a
+lost acked write or a diverged reply cache fails this test outright.
+
+The combined ``mtpu analyze`` umbrella (lint + race + crashcheck
+statics) is gated here too, so one test pins all three baselines.
+"""
+
+import json
+import os
+
+from metaopt_tpu.analysis.crashcheck import SUITES
+from metaopt_tpu.analysis.runner import (
+    DEFAULT_CRASH_BASELINE, analyze_main, crashcheck_main, diff_baseline,
+    load_baseline, run_crashcheck)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_crashcheck_all_suites_clean_against_baseline():
+    findings, stats = run_crashcheck(list(SUITES))
+    new = diff_baseline(findings, load_baseline(DEFAULT_CRASH_BASELINE))
+    assert not new, (
+        "new crash-consistency findings (fix them — dynamic MTP1xx can "
+        "never be baselined):\n" + "\n".join(f.render() for f in new))
+    # every suite actually enumerated states; "certified" means nonzero
+    assert stats["crash_states"] > 500
+    for name in SUITES:
+        assert stats[f"suite_{name}_s"] >= 0.0
+
+
+def test_crashcheck_cli_exit_code(monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    assert crashcheck_main([]) == 0
+    out = capsys.readouterr().out
+    assert "clean:" in out
+    assert "crash state" in out
+
+
+def test_analyze_umbrella_exit_code(monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    assert analyze_main([]) == 0
+    out = capsys.readouterr().out
+    assert "clean:" in out
+
+
+def test_analyze_json_reports_both_runtimes(monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    assert analyze_main(["--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"] == []
+    assert doc["lint_runtime_s"] >= 0.0
+    assert doc["crashcheck_runtime_s"] >= 0.0
+
+
+def test_dynamic_findings_never_in_baseline():
+    """Doctrine: the crash baseline may grandfather static style debt,
+    never a dynamic certification failure."""
+    baseline = load_baseline(DEFAULT_CRASH_BASELINE)
+    dynamic = [fp for fp in baseline if fp.startswith("MTP1")]
+    assert dynamic == []
+
+
+def test_prebound_reply_fix_not_baselined():
+    """The ISSUE-19 true positive — acked replies dropped when their WAL
+    records sit at or below a published snapshot's bound before
+    compaction finishes — is FIXED, not grandfathered: the snapshot,
+    archive, and evict suites certify zero MTP102 on the live recovery
+    paths."""
+    findings, _stats = run_crashcheck(["snapshot", "evict"], static=False)
+    bad = [f for f in findings if f.rule in ("MTP101", "MTP102")]
+    assert not bad, "\n".join(f.render() for f in bad)
