@@ -47,7 +47,14 @@ from metaopt_tpu.utils.procs import run_with_deadline, setup_xla_cache
 
 def preflight_backend(timeout_s: float = 90.0, retries: int = 1) -> bool:
     """Fall back to CPU if the TPU backend is unreachable (shared doctrine
-    in metaopt_tpu.utils.procs.preflight_backend). True = TPU live."""
+    in metaopt_tpu.utils.procs.preflight_backend). True = TPU live.
+
+    The verdict is cached per process (procs._PREFLIGHT_VERDICT), so the
+    many bench sections that re-check the backend pay the probe child at
+    most once. ``MTPU_BENCH_BACKEND=cpu`` skips the probe entirely and
+    forces the CPU path — the CI/laptop invocation that used to burn a
+    relay-probe timeout before every CPU-fallback run.
+    """
     from metaopt_tpu.utils.procs import preflight_backend as _pf
 
     return _pf(
@@ -946,6 +953,21 @@ def main() -> None:
                        "transfer_time_to_good_s", "transfer_cold_time_s"):
             if mt_row.get(mt_key) is not None:
                 coord_stats[mt_key] = mt_row[mt_key]
+
+        # fleet-fused suggest plane: same-run fused-vs-serial at the
+        # 256-resident TPE fleet (benchmarks/coord_scale.py
+        # run_fused_suggest). Both legs share one process and one fit
+        # state, alternating order round to round, so the speedup is a
+        # paired ratio — the gated figure plus the launch-count
+        # telemetry that proves the O(buckets) claim
+        from benchmarks.coord_scale import run_fused_suggest
+
+        fs_row = run_fused_suggest(residents=256, bucket_max=32)
+        for fs_key in ("fleet_suggest_speedup", "suggest_launches_per_tick",
+                       "serial_launches_per_tick", "buckets_per_tick",
+                       "bucket_occupancy"):
+            if fs_row.get(fs_key) is not None:
+                coord_stats[fs_key] = fs_row[fs_key]
     except Exception as err:  # the TPE headline must survive a coord break
         coord_stats["coord_bench_error"] = f"{type(err).__name__}: {err}"
 
@@ -1112,7 +1134,8 @@ def main() -> None:
                 "batch_eval_trials_per_s_pool64",
                 "batch_eval_speedup", "batch_eval_launches_per_pool",
                 "coord_trials_per_s_1k_exp", "coord_fairness_jain_1k",
-                "coord_evict_rss_ratio", "transfer_warm_trials_ratio"):
+                "coord_evict_rss_ratio", "transfer_warm_trials_ratio",
+                "fleet_suggest_speedup", "suggest_launches_per_tick"):
         if key in result["extra"]:
             compact[key] = result["extra"][key]
     # `stale` keeps its warn-never-fail contract for consumers that only

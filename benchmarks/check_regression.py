@@ -98,6 +98,18 @@ EVICT_RSS_FLOOR = 3.0
 TRANSFER_METRIC = "transfer_warm_trials_ratio"
 TRANSFER_CEILING = 0.5
 MT_TPS_METRIC = "coord_trials_per_s_1k_exp"
+#: fleet-fused suggest plane (ISSUE 20). The same-run fused-vs-serial
+#: wall-clock ratio at the widest resident TPE fleet ENFORCES its
+#: absolute floor the moment the artifact carries it — a paired
+#: host-CPU ratio (both legs share one process, one fit state, one
+#: run), so substrate drift cannot fake a pass. The launch-amortization
+#: claim (O(buckets) fleet launches, not O(residents) solo launches)
+#: enforces structurally whenever the artifact carries both sides:
+#: fused launches per tick must stay within 2x the bucket count.
+FLEET_SPEEDUP_METRIC = "fleet_suggest_speedup"
+FLEET_SPEEDUP_FLOOR = 3.0
+FLEET_LAUNCHES_METRIC = "suggest_launches_per_tick"
+FLEET_BUCKETS_METRIC = "buckets_per_tick"
 #: columnar completed-trial archive (ISSUE 17). Drift watches (lower is
 #: better, informational until a committed baseline carries them): bytes
 #: of coordinator RSS per completed trial at 1M, wall-clock of one
@@ -569,6 +581,36 @@ def main() -> int:
             rc = 1
         else:
             print(f"OK {mt_verdict}")
+
+    # fleet-fused suggest plane: the same-run speedup enforces its
+    # absolute floor whenever the artifact carries it, and the launch
+    # count must hold the O(buckets) amortization bound when both sides
+    # ride the artifact
+    fspd = extra.get(FLEET_SPEEDUP_METRIC)
+    if fspd is None:
+        print(f"{FLEET_SPEEDUP_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif float(fspd) < FLEET_SPEEDUP_FLOOR:
+        print(f"FAIL {FLEET_SPEEDUP_METRIC}: {float(fspd):.2f}x < the "
+              f"{FLEET_SPEEDUP_FLOOR:.0f}x fused-vs-serial floor (the "
+              "fused plane is not amortizing launches)")
+        rc = 1
+    else:
+        print(f"OK {FLEET_SPEEDUP_METRIC}: {float(fspd):.2f}x "
+              f"(floor {FLEET_SPEEDUP_FLOOR:.0f}x)")
+    flaunch = extra.get(FLEET_LAUNCHES_METRIC)
+    fbuckets = extra.get(FLEET_BUCKETS_METRIC)
+    if flaunch is None or not fbuckets:
+        print(f"{FLEET_LAUNCHES_METRIC}: artifact missing the metric — "
+              "nothing to gate against (pass)")
+    elif float(flaunch) > 2.0 * float(fbuckets):
+        print(f"FAIL {FLEET_LAUNCHES_METRIC}: {float(flaunch):.0f} "
+              f"launches/tick > 2x the {float(fbuckets):.0f} buckets "
+              "(per-experiment launches are leaking through the fuser)")
+        rc = 1
+    else:
+        print(f"OK {FLEET_LAUNCHES_METRIC}: {float(flaunch):.0f} "
+              f"launches/tick across {float(fbuckets):.0f} buckets")
 
     # columnar trial archive: the two same-run ratios enforce their
     # absolute floors whenever the artifact carries them; the drift

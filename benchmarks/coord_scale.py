@@ -55,6 +55,7 @@ overhead vs the in-process server, which the regression gate bounds.
                                      [--shard-experiments 4]
                                      [--trials-per-worker 16]
                                      [--recovery] [--save]
+                                     [--fused-suggest] [--residents 64 256]
 
 When the binary wire (protocol v2) is available, a "fused-json" config
 rides along automatically: the same fused deployment with the client
@@ -851,6 +852,154 @@ def run_multitenant(experiments: int = 1000, window_s: float = 5.0,
     return row
 
 
+def run_fused_suggest(residents: int = 256, rounds: int = 4,
+                      bucket_max: int = 32, n_obs: int = 10,
+                      seed: int = 0) -> dict:
+    """Fleet-fused suggest plane vs per-experiment launches, same run.
+
+    ``residents`` bare TPE instances (no server, no RPC — the suggest
+    plane alone) share one space and one observation count, so they all
+    land in ONE static bucket key and the fused plane's launch count per
+    sweep is ceil(residents / bucket_max). Each measured round creates
+    identical demand on both legs (the prefetch pool is emptied at the
+    live fit, exactly the post-``observe`` state SuggestAhead races to
+    refill), then serves one suggestion per experiment:
+
+    - **serial** — the shipped per-experiment plane, reproduced
+      faithfully: each experiment's demand is served by its OWN
+      SuggestAhead refill (``_suggest_ahead_work`` on its own thread —
+      exactly what ``observe()`` fires), each paying one
+      ``pool_prefetch``-wide launch + blocking readback: O(residents)
+      threads and launches per tick.
+    - **fused** — ONE ``SuggestFuser.fuse`` sweep column-stacks every
+      snapshot and launches once per pow2 bucket, then every experiment
+      serves from its refilled pool: O(buckets) launches, zero spawned
+      threads.
+
+    The automatic post-observe refill firing is suppressed on every
+    instance so neither leg races a stray background thread for the
+    demand — the serial leg then spawns the refill threads itself,
+    deterministically, which is the same stampede with the same
+    per-experiment work bodies. Both legs end with every pool refilled
+    at the same width and one suggestion served per experiment.
+    Bit-identity of the fused pool is the property suite's job
+    (tests/unit/test_fused_suggest.py); this driver asserts every
+    experiment actually fused (zero fallbacks) so the speedup is never
+    quietly measuring the fallback path.
+
+      fleet_suggest_speedup     serial_wall / fused_wall (gate: >=3 at
+                                256 residents)
+      suggest_launches_per_tick fused launches per sweep (gate: <=
+                                2 * buckets)
+    """
+    from metaopt_tpu.algo import TPE
+    from metaopt_tpu.coord.fuser import SuggestFuser
+    from metaopt_tpu.ledger.trial import Trial
+    from metaopt_tpu.space import build_space
+
+    rng = __import__("random").Random(seed)
+    space = build_space(SPACE)
+    named = []
+    for i in range(residents):
+        algo = TPE(space, seed=seed + i, n_initial_points=5,
+                   pool_prefetch=8)
+        # deterministic demand: the background refill must not race the
+        # measured legs for it (instance attr shadows the class method)
+        algo._suggest_ahead_ready = lambda: False
+        trials = []
+        for _ in range(n_obs):
+            params = {"lr": 10 ** rng.uniform(-5, -1),
+                      "mom": rng.uniform(0, 1)}
+            t = Trial(params=params, experiment=f"fs-exp{i}")
+            t.lineage = space.hash_point(params)
+            t.transition("reserved")
+            t.attach_results([{
+                "name": "loss", "type": "objective",
+                "value": (params["mom"] - 0.9) ** 2,
+            }])
+            t.transition("completed")
+            trials.append(t)
+        algo.observe(trials)
+        named.append((f"fs-exp{i}", algo))
+
+    fuser = SuggestFuser(bucket_max=bucket_max)
+
+    def make_demand():
+        # the post-observe state: pool empty at the live fit — exactly
+        # what fuse_snapshot treats as demand and suggest() refills
+        for _, a in named:
+            with a._kernel_lock:
+                a._prefetch = []
+                a._prefetch_n_obs = len(a._y)
+
+    def serial_leg():
+        make_demand()
+        t0 = time.perf_counter()
+        refills = [threading.Thread(target=a._suggest_ahead_work,
+                                    daemon=True) for _, a in named]
+        for th in refills:
+            th.start()
+        for th in refills:
+            th.join()
+        for _, a in named:
+            a.suggest(1)
+        return time.perf_counter() - t0
+
+    def fused_leg():
+        make_demand()
+        t0 = time.perf_counter()
+        stats = fuser.fuse(named)
+        for _, a in named:
+            a.suggest(1)
+        return time.perf_counter() - t0, stats
+
+    # warmup: compile the solo and the fleet kernel variants outside the
+    # measured window (one-time tracing would otherwise dominate round 0)
+    serial_leg()
+    _, warm_stats = fused_leg()
+    if warm_stats["fallback"] or warm_stats["fused"] != residents:
+        raise RuntimeError(
+            f"fused sweep fell back: {warm_stats} for {residents} "
+            "residents — the speedup would measure the fallback path")
+
+    serial_s, fused_s, launches = 0.0, 0.0, []
+    base_launches = sum(a._launches for _, a in named)
+    for r in range(rounds):
+        # alternate which leg goes first: allocator/cache warm-up inside
+        # one process would otherwise favor the later-scheduled leg
+        if r % 2 == 0:
+            serial_s += serial_leg()
+            dt, stats = fused_leg()
+        else:
+            dt, stats = fused_leg()
+            serial_s += serial_leg()
+        fused_s += dt
+        launches.append(stats["launches"])
+    # _launches counts per-experiment kernel launches only — the fused
+    # plane's bucket launches live in the fuser's own telemetry
+    serial_launches = (sum(a._launches for _, a in named)
+                       - base_launches) / rounds
+
+    buckets = -(-residents // max(1, fuser.bucket_max))
+    tel = fuser.telemetry()
+    return {
+        "mode": "fused-suggest",
+        "residents": residents,
+        "rounds": rounds,
+        "bucket_max": fuser.bucket_max,
+        "n_obs": n_obs,
+        "serial_wall_s": round(serial_s, 4),
+        "fused_wall_s": round(fused_s, 4),
+        "fleet_suggest_speedup": round(serial_s / max(fused_s, 1e-9), 2),
+        "suggest_launches_per_tick": max(launches),
+        "serial_launches_per_tick": round(serial_launches, 1),
+        "buckets_per_tick": buckets,
+        "bucket_occupancy": tel["last_occupancy"],
+        "fused_experiments": tel["fused_experiments"],
+        "fallback_experiments": tel["fallback_experiments"],
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workers", nargs="*", type=int, default=[1, 8, 32])
@@ -894,6 +1043,24 @@ def main():
     ap.add_argument(
         "--experiments", type=int, default=1000,
         help="fleet size for --multitenant (default 1000)",
+    )
+    ap.add_argument(
+        "--fused-suggest", action="store_true",
+        help="also run the fleet-fused suggest plane rows: one "
+             "SuggestFuser sweep (O(buckets) launches) vs per-experiment "
+             "inline launches (O(residents)) over the same demand, "
+             "same-run ratio per resident count",
+    )
+    ap.add_argument(
+        "--residents", nargs="*", type=int, default=[64, 256],
+        help="resident-experiment counts for --fused-suggest "
+             "(default 64 256; the >=3x gate rides the 256 row)",
+    )
+    ap.add_argument(
+        "--fuse-bucket-max", type=int, default=32,
+        help="fused-suggest bucket width cap (rounded down to pow2; 32 "
+             "is the one-core sweet spot — wider buckets amortize "
+             "launch overhead further but lengthen each program)",
     )
     ap.add_argument("--save", action="store_true")
     args = ap.parse_args()
@@ -1048,6 +1215,26 @@ def main():
         row.update(provenance())
         print(json.dumps(row), flush=True)
         rows.append(row)
+    if args.fused_suggest:
+        fs_by = {}
+        for n in sorted(set(args.residents)):
+            row = run_fused_suggest(
+                residents=n, bucket_max=args.fuse_bucket_max)
+            row.update(provenance())
+            print(json.dumps(row), flush=True)
+            rows.append(row)
+            fs_by[n] = row
+        # the headline the regression gate rides on: the widest fleet's
+        # same-run fused-vs-serial ratio and its launch amortization
+        top = fs_by[max(fs_by)]
+        print(json.dumps({
+            "summary": f"fleet_suggest_{top['residents']}r",
+            "fleet_suggest_speedup": top["fleet_suggest_speedup"],
+            "suggest_launches_per_tick": top["suggest_launches_per_tick"],
+            "serial_launches_per_tick": top["serial_launches_per_tick"],
+            "buckets_per_tick": top["buckets_per_tick"],
+            "residents": top["residents"],
+        }), flush=True)
     if args.save:
         stamp = time.strftime("%Y-%m-%d")
         path = os.path.join(REPO, "benchmarks", "results",

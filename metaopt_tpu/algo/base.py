@@ -18,11 +18,12 @@ design:
 from __future__ import annotations
 
 import atexit
+import dataclasses
 import logging
 import threading
 import weakref
 from abc import ABC, abstractmethod
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -172,6 +173,33 @@ class SuggestAhead:
             }
 
 
+@dataclasses.dataclass
+class FuseSnapshot:
+    """One experiment's frozen acquisition inputs, ready to be stacked
+    into a fleet-fused bucket launch (coord/fuser.py).
+
+    Taken by :meth:`BaseAlgorithm.fuse_snapshot` with the algorithm's
+    launch lock HELD (the fuser holds it from snapshot through commit, so
+    the captured device buffers cannot be donated away by a concurrent
+    refill and the allocated pool index cannot be raced). ``static_key``
+    carries every compile-relevant static — two experiments share a
+    bucket iff their ``(family, static_key)`` match exactly; ``arrays``
+    holds the per-experiment traced inputs the fuser column-stacks along
+    a new leading axis. ``count`` is the PRNG pool index this snapshot
+    allocated from the experiment's own stream: the fused launch keys
+    pool draws ``fold_in(fit_key, count + p)`` exactly as a solo launch
+    at that stream position would, which is the whole bit-identity
+    contract.
+    """
+
+    family: str                 # kernel family: "tpe" | "gp"
+    static_key: Tuple           # bucket statics (pads, widths, flags)
+    arrays: Dict[str, Any]      # per-experiment traced inputs (stackable)
+    count: int                  # allocated PRNG pool index (first pool)
+    fit_id: Tuple               # (n_obs, pending fp) — commit-time guard
+    n_pools: int = 1
+
+
 class BaseAlgorithm(ABC):
     """Pluggable optimizer over a :class:`Space`.
 
@@ -304,6 +332,42 @@ class BaseAlgorithm(ABC):
 
     def should_suspend(self, trial: Trial) -> bool:
         return False
+
+    # -- fleet-fused suggest plane (coord/fuser.py) ------------------------
+    def fuse_snapshot(self) -> Optional[FuseSnapshot]:
+        """Freeze this instance's next acquisition launch for fusion.
+
+        Returns None when the instance is ineligible — random/warm-up
+        phase, no demand (prefetch pool already fresh), surrogate not
+        current (GP mid-refit), or the algorithm simply doesn't
+        participate (this default). A None is the per-experiment
+        FALLBACK: the ordinary SuggestAhead path keeps serving exactly
+        as before. Caller MUST hold the algorithm's launch lock (see
+        :class:`FuseSnapshot`) across snapshot → launch → commit.
+        """
+        return None
+
+    def fuse_commit(self, snapshot: FuseSnapshot, rows: Any) -> bool:
+        """Fan one bucket-launch result slice back into the prefetch pool.
+
+        ``rows`` is this experiment's slice of the fleet kernel output
+        (unit-cube points). Returns True when the points were banked;
+        False when the fit moved between snapshot and commit and the
+        slice was discarded (burned pool indices — explicitly safe under
+        the (n_obs, pool_idx) stream keying).
+        """
+        return False
+
+    def fuse_abort(self, snapshot: FuseSnapshot) -> None:
+        """Hand an unused snapshot back (singleton bucket, launch error).
+
+        Implementations un-allocate the pool index taken by
+        ``fuse_snapshot`` when — and only when — nothing else has
+        allocated behind it, so a skipped fusion leaves the suggestion
+        stream exactly where a never-attempted one would. Caller still
+        holds the launch lock. Default: no-op (burned index, still
+        correct under the stream doctrine, just a wasted key).
+        """
 
     # -- reproducibility / persistence ------------------------------------
     def seed_rng(self, seed: Optional[int]) -> None:

@@ -266,8 +266,7 @@ def gp_chol_append(L, X, y_raw, i, params):
     return L.at[i, :].set(z).at[i, i].set(lam)
 
 
-@functools.partial(jax.jit, static_argnames=("n_cand", "n_out", "n_pools"))
-def gp_acquire_fused(
+def _gp_acquire_body(
     X,            # (N, d) unit-cube observations (pow2-padded device buffer)
     y_raw,        # (N,) RAW objectives (inf padding; may hold NaN/inf rows)
     L,            # (N, N) resident Cholesky factor of the masked gram
@@ -326,6 +325,54 @@ def gp_acquire_fused(
     _, top = jax.lax.top_k(ei, n_out)                   # (P, n_out)
     picked = jnp.take_along_axis(cand, top[:, :, None], axis=1)
     return picked.reshape(n_pools * n_out, d)
+
+
+#: per-experiment entry point; the traced pipeline lives in
+#: ``_gp_acquire_body`` so the fleet kernel vmaps the IDENTICAL
+#: computation (same shared-body doctrine as ops/tpe_math.py).
+gp_acquire_fused = functools.partial(
+    jax.jit, static_argnames=("n_cand", "n_out", "n_pools")
+)(_gp_acquire_body)
+
+
+@functools.partial(jax.jit, static_argnames=("n_cand", "n_out", "n_pools"))
+def gp_acquire_fleet(
+    X,            # (B, N, d) column-stacked observation buffers
+    y_raw,        # (B, N) raw objectives
+    L,            # (B, N, N) stacked resident Cholesky factors (equal cap)
+    n,            # (B,) live row counts
+    mu,           # (B,) standardization means
+    sd,           # (B,) standardization stds
+    fit_key,      # (B, key) per-experiment fit keys
+    count,        # (B,) pool indices
+    params,       # stacked hyperparameters: log_ls (B,d), log_amp/log_noise (B,)
+    *,
+    n_cand: int,
+    n_out: int,
+    n_pools: int,
+):
+    """``gp_acquire_fused`` for a BUCKET of experiments in ONE launch.
+
+    The steady-state acquisition is surrogate-as-input (resident factor +
+    fitted hyperparameters), so batching across experiments is a pure
+    vmap of the per-experiment body over stacked equal-cap factors — the
+    O(n³) fit/anchor work stays per-experiment (a mid-refit member falls
+    back to its own path; see coord/fuser.py's fallback matrix). Every
+    column (and each params leaf) accepts either the stacked (B, ...)
+    array or a B-tuple of per-experiment leaves, stacked in-trace (see
+    ``ops.tpe_math._stk``: one dispatch per bucket, device buffers stay
+    device-side). Row b is bitwise what ``gp_acquire_fused`` returns for
+    experiment b alone. Returns (B, n_pools * n_out, d).
+    """
+    from metaopt_tpu.ops.tpe_math import _stk
+
+    body = functools.partial(
+        _gp_acquire_body, n_cand=n_cand, n_out=n_out, n_pools=n_pools,
+    )
+    return jax.vmap(body)(
+        _stk(X), _stk(y_raw), _stk(L), _stk(n), _stk(mu), _stk(sd),
+        _stk(fit_key), _stk(count), {k: _stk(v) for k, v in params.items()},
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("fit_iters",))
@@ -539,6 +586,10 @@ class GPBO(SuggestAhead, BaseAlgorithm):
         self._pending_X: List[np.ndarray] = []   # lie rows, ephemeral
         self._pending_fp: tuple = ()
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
+        # fit key cache (see TPE): PRNGKey + fold_in are two dispatched
+        # device ops, refolded identically on every launch at one fit
+        self._fit_key = None
+        self._fit_key_n = -1
         # pooled suggestions from the last launch, valid while the fit
         # (observation count) is unchanged — same doctrine as TPE: the
         # launch computes a pow2-padded pool anyway, so serve the leftovers
@@ -556,6 +607,10 @@ class GPBO(SuggestAhead, BaseAlgorithm):
         self._kernel_lock = threading.RLock()
         self._launch_lock = threading.RLock()
         self._ei_active = False
+        # fleet-fused suggest plane counters (coord/fuser.py); guarded by
+        # _kernel_lock like TPE's
+        self._fused_commits = 0
+        self._fused_discards = 0
         self._init_suggest_ahead(suggest_prefetch_depth)
 
         # transfer warm-start: the factor is O(n³) in resident rows, so a
@@ -713,8 +768,11 @@ class GPBO(SuggestAhead, BaseAlgorithm):
                 n_pools = pad_pow2(-(-num // pool_w), minimum=1)
             count = self._pool_idx
             self._pool_idx += n_pools
-            fit_key = jax.random.fold_in(
-                jax.random.PRNGKey(self._kernel_seed), n)
+            if self._fit_key_n != n:
+                self._fit_key = jax.random.fold_in(
+                    jax.random.PRNGKey(self._kernel_seed), n)
+                self._fit_key_n = n
+            fit_key = self._fit_key
             pending = (list(self._pending_X)
                        if (self._pending_X
                            and self.parallel_strategy is not None
@@ -753,6 +811,115 @@ class GPBO(SuggestAhead, BaseAlgorithm):
                 pt[fid.name] = fid.high
             pts.append(pt)
         return pts
+
+    # -- fleet-fused suggest plane (coord/fuser.py) ------------------------
+    def fuse_snapshot(self):
+        """Freeze one steady-state acquisition launch for a fleet bucket.
+
+        Fused GP acquisition is surrogate-as-INPUT: it only engages when
+        the resident factor is already current through ``n`` at the
+        buffer's capacity with no re-anchor due — i.e. when
+        ``_ensure_factor`` would be a complete no-op, so the fused and
+        per-experiment paths consume byte-identical (L, params). A cold
+        start, a pending grow/append, a due re-anchor, or an unreplayed
+        restore trace all return None: the per-experiment path owns
+        every O(n³) regime (the ISSUE's mid-refit fallback). Caller
+        holds ``_launch_lock`` through ``fuse_commit``.
+        """
+        from metaopt_tpu.algo.base import FuseSnapshot
+
+        with self._kernel_lock:
+            n = len(self._y)
+            if n < self.n_initial_points:
+                return None
+            if self._prefetch_n_obs == n and self._prefetch:
+                return None  # no demand
+            y_fin = [v for v in self._y if np.isfinite(v)]
+            if not y_fin:
+                return None  # uniform-explore regime
+            if self._restore_trace is not None or self._params is None:
+                return None
+            self._buf.sync(self._X, self._y)
+            if not self._factor.current(n, self._buf.cap):
+                return None  # factor maintenance owed — fallback
+            if (not self.incremental
+                    or (n - self._factor.anchor_n) >= self.reanchor_every):
+                return None  # re-anchor due — fallback
+            if self._pool_n != n:
+                self._pool_n, self._pool_idx = n, 0
+            pool_w = pad_pow2(self.pool_prefetch, minimum=1)
+            count = self._pool_idx
+            self._pool_idx += 1
+            if self._fit_key_n != n:
+                self._fit_key = jax.random.fold_in(
+                    jax.random.PRNGKey(self._kernel_seed), n)
+                self._fit_key_n = n
+            fit_key = self._fit_key
+            pending = (list(self._pending_X)
+                       if (self._pending_X
+                           and self.parallel_strategy is not None
+                           and n > 0)
+                       else [])
+            pending_fp = self._pending_fp
+            mu_o = float(np.mean(y_fin))
+            sd_o = float(np.std(y_fin) + 1e-8)
+            stats = list(y_fin)
+            lie = None
+            if pending:
+                lie = (mu_o if self.parallel_strategy == "mean"
+                       else float(np.max(y_fin)))
+                stats += [lie] * len(pending)
+            stats_arr = np.asarray(stats, np.float32)
+            mu_a, sd_a = float(stats_arr.mean()), float(stats_arr.std() + 1e-8)
+        # overlay factor composition outside the kernel lock, exactly like
+        # _launch_ei (the caller's _launch_lock serializes factor readers)
+        Xq, yq, n_eff, L = self._buf.Xdev, self._buf.ydev, n, self._factor.L
+        if pending and lie is not None and np.isfinite(lie):
+            Xq, yq, n_eff, L = self._aug_factor(pending, lie, n, pending_fp)
+        return FuseSnapshot(
+            family="gp",
+            static_key=(
+                int(Xq.shape[0]), self.cube.n_dims,
+                pad_pow2(self.n_candidates), pool_w,
+            ),
+            arrays={
+                "X": Xq, "y": yq, "L": L, "n": n_eff,
+                "mu": np.float32(mu_a), "sd": np.float32(sd_a),
+                "key": fit_key, "count": count,
+                "log_ls": self._params["log_ls"],
+                "log_amp": self._params["log_amp"],
+                "log_noise": self._params["log_noise"],
+            },
+            count=count,
+            fit_id=(n, pending_fp),
+        )
+
+    def fuse_commit(self, snapshot, rows) -> bool:
+        """Bank one bucket-launch slice (same protocol as TPE's)."""
+        fid = self.space.fidelity
+        pts = []
+        for row in np.asarray(rows):
+            pt = self.cube.untransform(np.asarray(row))
+            if fid is not None:
+                pt[fid.name] = fid.high
+            pts.append(pt)
+        with self._kernel_lock:
+            if (len(self._y), self._pending_fp) != snapshot.fit_id:
+                self._fused_discards += 1
+                return False
+            if self._prefetch_n_obs != len(self._y):
+                self._prefetch = []
+                self._prefetch_n_obs = len(self._y)
+            self._prefetch.extend(pts)
+            self._fused_commits += 1
+            return True
+
+    def fuse_abort(self, snapshot) -> None:
+        """Un-allocate the snapshot's pool index (see TPE.fuse_abort)."""
+        with self._kernel_lock:
+            if (self._pool_n == snapshot.fit_id[0]
+                    and self._pool_idx == snapshot.count + 1):
+                self._pool_idx = snapshot.count
 
     # -- incremental factor maintenance ------------------------------------
     def _ensure_factor(self, n: int, mu: float, sd: float) -> None:
@@ -876,6 +1043,8 @@ class GPBO(SuggestAhead, BaseAlgorithm):
             "bulk_uploads": self._buf.bulk_uploads,
             "reallocs": self._buf.reallocs,
             "kernel_launches": self._launches,
+            "fused_commits": self._fused_commits,
+            "fused_discards": self._fused_discards,
             **self._factor.telemetry(),
             **self.suggest_ahead_telemetry(),
         }
@@ -888,6 +1057,8 @@ class GPBO(SuggestAhead, BaseAlgorithm):
         with getattr(self, "_launch_lock", threading.RLock()):
             with getattr(self, "_kernel_lock", threading.RLock()):
                 self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
+                self._fit_key = None
+                self._fit_key_n = -1
                 self._prefetch = []
                 self._prefetch_n_obs = -1
                 self._pool_n = -1

@@ -243,6 +243,17 @@ class CholeskyFactor:
         self.ops.append(("g", newcap))
         self.grows += 1
 
+    def current(self, n: int, cap: int) -> bool:
+        """True when the factor already covers observation count ``n`` at
+        buffer capacity ``cap`` — i.e. an acquisition launch can consume
+        ``L`` as-is with zero factor maintenance. The fleet-fused suggest
+        plane's eligibility gate: a GP whose factor is NOT current
+        (mid-refit, pending grow, cold start) falls back to its own
+        per-experiment path rather than dragging O(n³) work into a
+        bucket launch."""
+        return (self.L is not None and self.rows == n
+                and self.cap == cap and self.anchor_n >= 0)
+
     def append_row(self, L, i: int) -> None:
         """Commit the factor extended through observation row ``i``."""
         self.L = L

@@ -125,6 +125,11 @@ class TPE(SuggestAhead, BaseAlgorithm):
         # suggestion streams (they would dup-collide on register forever)
         self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
         self._base_key = None                     # PRNGKey, created lazily
+        # fit key cache: fold_in(base, n) is a dispatched device op worth
+        # ~0.1ms on CPU, and every launch at one fit folds the SAME key —
+        # the fused plane sweeps hundreds of unchanged fits per tick
+        self._fit_key = None
+        self._fit_key_n = -1
         # PRNG stream position as (observation count, pool index within
         # that fit) — NOT a global launch counter: a speculative refill
         # that lands just before more observations arrive consumes a
@@ -160,6 +165,11 @@ class TPE(SuggestAhead, BaseAlgorithm):
         self._kernel_lock = threading.RLock()
         self._launch_lock = threading.RLock()
         self._ei_active = False
+        # fleet-fused suggest plane counters (coord/fuser.py): pools fed
+        # into _prefetch by a bucket launch vs discarded stale at commit.
+        # Guarded by _kernel_lock (mutated only at snapshot/commit).
+        self._fused_commits = 0
+        self._fused_discards = 0
         self._init_suggest_ahead(suggest_prefetch_depth)
 
     # -- observe -----------------------------------------------------------
@@ -469,6 +479,8 @@ class TPE(SuggestAhead, BaseAlgorithm):
             "bulk_uploads": b.bulk_uploads,
             "reallocs": b.reallocs,
             "kernel_launches": self._launches,
+            "fused_commits": self._fused_commits,
+            "fused_discards": self._fused_discards,
             **self.suggest_ahead_telemetry(),
         }
 
@@ -544,7 +556,10 @@ class TPE(SuggestAhead, BaseAlgorithm):
             # launches other fits made — see _pool_n in __init__
             count = self._pool_idx
             self._pool_idx += n_pools
-            fit_key = jax.random.fold_in(self._base_key, n)
+            if self._fit_key_n != n:
+                self._fit_key = jax.random.fold_in(self._base_key, n)
+                self._fit_key_n = n
+            fit_key = self._fit_key
             X_dev, y_dev, n_eff = self._buf.Xdev, self._buf.ydev, n
             if (self._pending_X and self.parallel_strategy is not None
                     and n > 0):
@@ -595,6 +610,124 @@ class TPE(SuggestAhead, BaseAlgorithm):
             out.append(pt)
         return out
 
+    # -- fleet-fused suggest plane (coord/fuser.py) ------------------------
+    def fuse_snapshot(self):
+        """Freeze one pool-refill launch for a fleet bucket.
+
+        Mirrors ``_launch_ei``'s snapshot phase EXACTLY (buffer sync,
+        pending-lie overlay, pad computation, pool-index allocation, fit
+        keying) for a single pool of width ``pad_pow2(pool_prefetch)`` —
+        the refill SuggestAhead would have paid. Caller holds
+        ``_launch_lock`` from here through ``fuse_commit``, so the
+        captured device buffers cannot be donated away by a concurrent
+        sync and the allocated pool index cannot be reordered. Returns
+        None (per-experiment fallback) in the random phase or when the
+        prefetch pool is already fresh and non-empty (no demand).
+        """
+        from metaopt_tpu.algo.base import FuseSnapshot
+
+        with self._kernel_lock:
+            n = len(self._y)
+            if n < self.n_initial_points:
+                return None
+            if self._prefetch_n_obs == n and self._prefetch:
+                return None  # no demand: the banked pool is still fresh
+            if self._base_key is None:
+                self._base_key = jax.random.PRNGKey(self._kernel_seed)
+            if self._n_choices_dev is None:
+                self._n_choices_dev = jnp.asarray(
+                    self.cube.n_choices.astype(np.int32))
+                self._cont_mask_dev = jnp.asarray(~self.cube.categorical_mask)
+            self._buf.sync(self._X, self._y)
+            if self._pool_n != n:
+                self._pool_n, self._pool_idx = n, 0
+            pool_w = pad_pow2(self.pool_prefetch, minimum=1)
+            count = self._pool_idx
+            self._pool_idx += 1
+            if self._fit_key_n != n:
+                self._fit_key = jax.random.fold_in(self._base_key, n)
+                self._fit_key_n = n
+            fit_key = self._fit_key
+            X_dev, y_dev, n_eff = self._buf.Xdev, self._buf.ydev, n
+            if (self._pending_X and self.parallel_strategy is not None
+                    and n > 0):
+                lie = (float(np.nanmean(self._y))
+                       if self.parallel_strategy == "mean"
+                       else float(np.nanmax(self._y)))
+                if np.isfinite(lie):
+                    aug_key = (n, self._pending_fp)
+                    if self._aug_key != aug_key:
+                        Xa, ya, ntot = self._buf.overlay(
+                            self._pending_X, lie)
+                        self._aug_key = aug_key
+                        self._aug_X, self._aug_y = Xa, ya
+                        self._aug_n = ntot
+                    X_dev, y_dev = self._aug_X, self._aug_y
+                    n_eff = self._aug_n
+            g_pad, b_pad = split_pads(n_eff, self.gamma)
+            return FuseSnapshot(
+                family="tpe",
+                static_key=(
+                    int(X_dev.shape[0]), self.cube.n_dims,
+                    self.n_ei_candidates, pool_w, self._kmax,
+                    bool(self.equal_weight), g_pad, b_pad,
+                ),
+                arrays={
+                    "X": X_dev, "y": y_dev, "n": n_eff, "count": count,
+                    "key": fit_key,
+                    "n_choices": self._n_choices_dev,
+                    "cont_mask": self._cont_mask_dev,
+                    "gamma": np.float32(self.gamma),
+                    "prior_weight": np.float32(self.prior_weight),
+                    "full_weight_num": np.float32(self.full_weight_num),
+                    "n_prior": np.int32(self._n_prior),
+                    "transfer_discount": np.float32(self.transfer_discount),
+                },
+                count=count,
+                fit_id=(n, self._pending_fp),
+            )
+
+    def fuse_commit(self, snapshot, rows) -> bool:
+        """Bank one bucket-launch slice into the prefetch pool.
+
+        Same commit protocol as ``_refill_pool``: discard if the fit
+        moved between snapshot and launch (the pool index is burned —
+        safe under (n_obs, pool_idx) keying). Caller still holds
+        ``_launch_lock``, so no other launch can have allocated indices
+        behind our back: a committed slice lands in the exact stream
+        position a solo refill at ``snapshot.count`` would have.
+        """
+        fid = self.space.fidelity
+        pts = []
+        for row in np.asarray(rows):
+            pt = self.cube.untransform(row)
+            if fid is not None:
+                pt[fid.name] = fid.high
+            pts.append(pt)
+        with self._kernel_lock:
+            if (len(self._y), self._pending_fp) != snapshot.fit_id:
+                self._fused_discards += 1
+                return False
+            if self._prefetch_n_obs != len(self._y):
+                self._prefetch = []
+                self._prefetch_n_obs = len(self._y)
+            self._prefetch.extend(pts)
+            self._fused_commits += 1
+            return True
+
+    def fuse_abort(self, snapshot) -> None:
+        """Un-allocate the snapshot's pool index (singleton bucket).
+
+        Safe because the caller still holds ``_launch_lock`` — the only
+        other allocator — so ``_pool_idx`` can only have moved if the
+        fit changed (pool reset), in which case we leave it alone and
+        the index is burned (still correct, just a wasted key).
+        """
+        with self._kernel_lock:
+            if (self._pool_n == snapshot.fit_id[0]
+                    and self._pool_idx == snapshot.count + 1):
+                self._pool_idx = snapshot.count
+
     def score(self, point: Dict[str, Any]) -> float:
         """EI score of an arbitrary point under the current l/g fit."""
         with self._kernel_lock:
@@ -626,6 +759,8 @@ class TPE(SuggestAhead, BaseAlgorithm):
             with getattr(self, "_kernel_lock", threading.RLock()):
                 self._kernel_seed = int(self.rng.integers(0, 2**31 - 1))
                 self._base_key = None
+                self._fit_key = None
+                self._fit_key_n = -1
                 self._pool_n = -1
                 self._pool_idx = 0
                 self._prefetch = []

@@ -237,6 +237,7 @@ def default_race_config() -> RaceConfig:
         "ShardSupervisor": "metaopt_tpu.coord.shards",
         "BatchedExecutor": "metaopt_tpu.executor.batched",
         "VirtualClock": "metaopt_tpu.sim.clock",
+        "SuggestFuser": "metaopt_tpu.coord.fuser",
     }
     rc.race_exempt = {
         ("CoordServer", "_mut"),
@@ -271,6 +272,9 @@ def default_race_config() -> RaceConfig:
         "ShardSupervisor._failover_shard",
         # a shared executor's pool evaluations run on worker threads
         "BatchedExecutor.execute_batch",
+        # the fused suggest sweep runs on the server housekeeping thread,
+        # racing per-experiment suggest/observe on RPC threads
+        "SuggestFuser.tick",
     }
     return rc
 
@@ -310,6 +314,7 @@ def default_config() -> LintConfig:
         "ShardSupervisor": {"_procs_lock"},
         "BatchedExecutor": {"_tel_lock"},
         "VirtualClock": {"_lock"},
+        "SuggestFuser": {"_lock"},
     }
     cfg.lock_factories = {
         "_exp_lock": (EXP_LOCK, ["CoordServer._exp_locks_guard"]),
@@ -353,6 +358,10 @@ def default_config() -> LintConfig:
         # pure float arithmetic on the virtual "now"; a threaded server
         # on a virtual clock takes it on every time()/monotonic() read
         "VirtualClock._lock",
+        # telemetry counter rollup only; snapshots, bucket launches, and
+        # commits all run BEFORE the lock is taken (fuse() holds member
+        # launch locks during the sweep, never the fuser's own lock)
+        "SuggestFuser._lock",
     }
     cfg.guarded_attrs = {
         "CoordServer": {
@@ -499,6 +508,17 @@ def default_config() -> LintConfig:
             # the lock, and a test's advance()/advance_to() races them
             # when the clock is shared with a live threaded server
             "_now": "VirtualClock._lock",
+        },
+        "SuggestFuser": {
+            # sweep/launch/commit telemetry: the housekeeping tick thread
+            # writes, tenant_stats/bench readers snapshot cross-thread
+            "_ticks": "SuggestFuser._lock",
+            "_bucket_launches": "SuggestFuser._lock",
+            "_fused_experiments": "SuggestFuser._lock",
+            "_fallback_experiments": "SuggestFuser._lock",
+            "_last_buckets": "SuggestFuser._lock",
+            "_last_fused": "SuggestFuser._lock",
+            "_last_occupancy": "SuggestFuser._lock",
         },
     }
     cfg.receiver_roles = {

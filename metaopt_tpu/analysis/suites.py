@@ -34,7 +34,14 @@ shared state while instrumented:
   scheduler under ``_tenant_lock`` and the residency bookkeeping
   (``_evicted``, ``_exp_last_touch``, eviction/hydration counters)
   under ``_evict_lock`` race the stub-indexed ``tenant_stats`` read
-  path.
+  path. A seventh phase turns the fused suggest plane on
+  (``fuse_suggest=True`` at an aggressive tick interval) over a
+  TPE-hosting fleet wider than the residency budget: the fuser's
+  demand sweep (non-blocking launch-lock acquires, bucket launches,
+  commit/abort) races per-experiment ``worker_cycle`` produce legs,
+  the evict sweep tearing members down mid-sweep, and a
+  ``tenant_stats`` prober reading the fuser telemetry under
+  ``SuggestFuser._lock``.
 * ``algo`` — CMA-ES (numpy-only: no compile cost inside the detector)
   with ``suggest_prefetch_depth=2``, a driver thread running
   suggest/observe generations against the SuggestAhead refill thread,
@@ -136,6 +143,7 @@ def suite_coord(scale: int = 1) -> None:
     _coord_batched_phase(scale)
     _coord_mixed_wire_phase(scale)
     _coord_multitenant_phase(scale)
+    _coord_fuser_phase(scale)
     _coord_archive_phase(scale)
 
 
@@ -610,6 +618,99 @@ def _coord_multitenant_phase(scale: int = 1) -> None:
                 t.start()
             for t in threads:
                 t.join(timeout=120.0)
+            stop.set()
+            p.join(timeout=30.0)
+            if errors:
+                raise errors[0]
+
+
+def _coord_fuser_phase(scale: int = 1) -> None:
+    """Fused-suggest leg of the coord suite: the fuser's demand sweep
+    (housekeeping-adjacent ``coord-fuser`` thread) against a TPE-hosting
+    fleet wider than the residency budget. The surface under test is the
+    fuser tick racing per-experiment produce legs for each member's
+    launch lock (non-blocking acquire → snapshot → bucket launch →
+    commit/abort), the evict sweep tearing members down between the
+    sweep's lock hand-offs, and the telemetry rollup under
+    ``SuggestFuser._lock`` racing a ``tenant_stats`` prober. TPE's
+    ``n_initial_points`` is small so the EI path (the only fusable
+    phase) engages within the budget."""
+    from metaopt_tpu.coord import CoordLedgerClient, CoordServer
+
+    per_exp = 8 * scale
+    with tempfile.TemporaryDirectory() as td:
+        with CoordServer(evict_dir=os.path.join(td, "evict"),
+                         stale_timeout_s=5.0, sweep_interval_s=0.1,
+                         max_resident=3, fuse_suggest=True,
+                         fuse_interval_s=0.02, fuse_bucket_max=4) as s:
+            host, port = s.address
+            c0 = CoordLedgerClient(host=host, port=port)
+            names = []
+            for k in range(4):
+                nm = f"race-fuse-{k}"
+                c0.create_experiment({
+                    "name": nm,
+                    "space": {"x": "uniform(-5, 5)"},
+                    "max_trials": per_exp, "pool_size": 2,
+                    "algorithm": {"tpe": {
+                        "seed": 17 + k, "n_initial_points": 2,
+                        "pool_prefetch": 4,
+                    }},
+                })
+                names.append(nm)
+            stop = threading.Event()
+            errors: List[BaseException] = []
+
+            def prober() -> None:
+                # fuser telemetry rollup racing live sweeps + evictions
+                try:
+                    c = CoordLedgerClient(host=host, port=port)
+                    last = -1
+                    while not stop.is_set():
+                        st = c.tenant_stats()
+                        fu = st.get("fuser")
+                        if fu is not None:
+                            if fu["ticks"] < last:
+                                raise AssertionError(
+                                    f"fuser tick count regressed: {fu}")
+                            last = fu["ticks"]
+                except BaseException as e:
+                    errors.append(e)
+
+            def worker(i: int) -> None:
+                try:
+                    c = CoordLedgerClient(host=host, port=port)
+                    nm = names[i]
+                    complete = None
+                    for _ in range(per_exp * 12):
+                        out = c.worker_cycle(
+                            nm, f"fw{i}", pool_size=2, complete=complete)
+                        complete = None
+                        t = out["trial"]
+                        if t is None:
+                            if out["counts"]["completed"] >= per_exp:
+                                return
+                            continue
+                        t.attach_results([{
+                            "name": "objective", "type": "objective",
+                            "value": (t.params["x"] - 1) ** 2,
+                        }])
+                        t.transition("completed")
+                        complete = {"trial": t.to_dict(),
+                                    "expected_status": "reserved",
+                                    "expected_worker": f"fw{i}"}
+                except BaseException as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        name=f"race-fuse-worker-{i}")
+                       for i in range(4)]
+            p = threading.Thread(target=prober, name="race-fuse-prober")
+            p.start()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180.0)
             stop.set()
             p.join(timeout=30.0)
             if errors:

@@ -367,6 +367,20 @@ def build_parser() -> argparse.ArgumentParser:
                           '\'{"acme": 3, "batch": 1}\' — deficit '
                           "round-robin shares of produce capacity "
                           "(unlisted tenants weigh 1.0)")
+    srv.add_argument("--fuse-suggest", dest="fuse_suggest",
+                     action="store_true", default=None,
+                     help="fleet-fused suggest plane: batch compatible "
+                          "resident experiments' acquisition launches "
+                          "into ONE vmapped kernel per shape bucket each "
+                          "tick, feeding their prefetch pools off the "
+                          "reply path (suggestions stay bit-identical "
+                          "to the per-experiment path)")
+    srv.add_argument("--fuse-bucket-max", dest="fuse_bucket_max",
+                     type=int, default=None, metavar="N",
+                     help="max experiments fused into one bucket launch "
+                          "(rounded down to a power of two; default 32 "
+                          "— bounds worst-case launch latency and "
+                          "per-bucket device memory)")
 
     reb = sub.add_parser(
         "rebalance",
@@ -1099,13 +1113,25 @@ def _cmd_tenants(args, cfg: Dict[str, Any]) -> int:
           f"({stats.get('evictions', 0)} evictions, "
           f"{stats.get('hydrations', 0)} hydrations)")
     tenants = stats.get("tenants") or {}
+    fuser = stats.get("fuser")
+    if fuser:
+        print(f"fused suggest: {fuser.get('bucket_launches', 0)} bucket "
+              f"launches, {fuser.get('fused_experiments', 0)} fused / "
+              f"{fuser.get('fallback_experiments', 0)} fallback; last tick "
+              f"{fuser.get('last_buckets', 0)} buckets, occupancy "
+              f"{fuser.get('last_occupancy', 0.0):g}")
     for tenant in sorted(tenants):
         row = tenants[tenant]
-        print(f"  {tenant}: {row.get('experiments', 0)} experiments "
-              f"({row.get('evicted', 0)} evicted), weight "
-              f"{row.get('weight', 1.0):g}, produce "
-              f"{row.get('granted', 0)} granted / "
-              f"{row.get('denied', 0)} denied")
+        line = (f"  {tenant}: {row.get('experiments', 0)} experiments "
+                f"({row.get('evicted', 0)} evicted), weight "
+                f"{row.get('weight', 1.0):g}, produce "
+                f"{row.get('granted', 0)} granted / "
+                f"{row.get('denied', 0)} denied")
+        if "suggest_hit_rate" in row:
+            line += (f", suggest hit rate {row['suggest_hit_rate']:.0%}"
+                     f" (fused {row.get('fused_commits', 0)} / discarded "
+                     f"{row.get('fused_discards', 0)})")
+        print(line)
     if args.experiments:
         per = stats.get("experiments") or {}
         for name in sorted(per):
@@ -1900,6 +1926,12 @@ def _cmd_serve(args, cfg: Dict[str, Any]) -> int:
         max_resident=(args.max_resident if args.max_resident is not None
                       else coord_cfg.get("max_resident")),
         tenant_weights=_tenant_weights(args, coord_cfg),
+        fuse_suggest=(args.fuse_suggest
+                      if args.fuse_suggest is not None
+                      else bool(coord_cfg.get("fuse_suggest", False))),
+        fuse_bucket_max=(args.fuse_bucket_max
+                         if args.fuse_bucket_max is not None
+                         else coord_cfg.get("fuse_bucket_max", 32)),
     )
     serve_forever(server)
     return 0
@@ -1963,6 +1995,12 @@ def _serve_sharded(args, coord_cfg: Dict[str, Any], n_shards: int) -> int:
         max_resident=(args.max_resident if args.max_resident is not None
                       else coord_cfg.get("max_resident")),
         tenant_weights=_tenant_weights(args, coord_cfg),
+        fuse_suggest=(args.fuse_suggest
+                      if args.fuse_suggest is not None
+                      else bool(coord_cfg.get("fuse_suggest", False))),
+        fuse_bucket_max=(args.fuse_bucket_max
+                         if args.fuse_bucket_max is not None
+                         else coord_cfg.get("fuse_bucket_max")),
     )
     stop = threading.Event()
     prev = signal.signal(signal.SIGTERM, lambda *_: stop.set())

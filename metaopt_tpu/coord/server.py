@@ -89,6 +89,7 @@ from metaopt_tpu.coord.shards import (
     experiment_of,
     map_version,
 )
+from metaopt_tpu.coord.fuser import SuggestFuser
 from metaopt_tpu.coord.tenancy import FairProduceScheduler
 from metaopt_tpu.coord.wal import WriteAheadLog, fsync_dir, read_records
 from metaopt_tpu.executor.faults import faults
@@ -323,6 +324,9 @@ class CoordServer:
         evict_dir: Optional[str] = None,
         archive_segment_rows: Optional[int] = None,
         archive_completed: bool = True,
+        fuse_suggest: bool = False,
+        fuse_bucket_max: int = 32,
+        fuse_interval_s: float = 0.05,
         clock: Optional[Clock] = None,
     ) -> None:
         #: injectable time source (utils/clock.py). All wall stamps
@@ -532,6 +536,16 @@ class CoordServer:
         self._exp_last_touch: Dict[str, float] = {}
         self._evictions = 0
         self._hydrations = 0
+
+        #: fleet-fused suggest plane (coord/fuser.py): one demand sweep
+        #: per tick collapses the resident experiments' acquisition
+        #: launches into O(buckets) vmapped fleet launches that feed each
+        #: algorithm's prefetch pool off the reply path. Opt-in
+        #: (``fuse_suggest=True`` / ``mtpu serve --fuse-suggest``); when
+        #: off, nothing changes — the fuser is never constructed.
+        self.fuse_interval_s = float(fuse_interval_s)
+        self._fuser = (SuggestFuser(self, bucket_max=fuse_bucket_max)
+                       if fuse_suggest else None)
 
         #: housekeeping cadence stamps (monotonic — the historical code
         #: kept these in wall time, which raced NTP steps). Initialized
@@ -912,6 +926,8 @@ class CoordServer:
                     and (self.evict_idle_s is not None
                          or self.max_resident is not None))):
             self._spawn(self._housekeeping_loop, "coord-sweep")
+        if self._fuser is not None and self.host_algorithms:
+            self._spawn(self._fuser_loop, "coord-fuser")
         log.info("coordinator listening on %s:%d", *self.address)
         return self
 
@@ -996,6 +1012,20 @@ class CoordServer:
         while not self._stopping.wait(min(self.sweep_interval_s, 1.0)):
             self.housekeeping_step()
 
+    def _fuser_loop(self) -> None:
+        """Fused-suggest demand sweep at ``fuse_interval_s`` cadence.
+
+        A tick with no demand (every resident pool fresh) costs one lock
+        sweep and launches nothing, so a short interval is cheap; a tick
+        with demand replaces O(resident) per-experiment launches with
+        O(buckets) fleet launches.
+        """
+        while not self._stopping.wait(self.fuse_interval_s):
+            try:
+                self._fuser.tick()
+            except Exception:
+                log.exception("fused suggest tick failed")
+
     def housekeeping_step(self) -> None:
         """One housekeeping beat: stale sweep, due snapshot, evict sweep.
 
@@ -1032,6 +1062,13 @@ class CoordServer:
                 self.evict_sweep()
             except Exception:
                 log.exception("evict sweep failed")
+        if self._fuser is not None and self.host_algorithms:
+            # simulator-driven hosts call housekeeping_step directly with
+            # no loop threads — give them the fused sweep on the same beat
+            try:
+                self._fuser.tick()
+            except Exception:
+                log.exception("fused suggest tick failed")
 
     # -- snapshot / restore ------------------------------------------------
     def snapshot(self, path: str) -> None:
@@ -1607,6 +1644,35 @@ class CoordServer:
         for tenant, d in tenants.items():
             # configured weight surfaces even before any produce history
             d.setdefault("weight", self._sched.weight(tenant))
+        # per-tenant suggest-plane health: aggregate each resident hosted
+        # algorithm's SuggestAhead + fused counters by owning tenant (a
+        # tenant whose hit rate sags is paying inline launches on its
+        # reply path — the signal `mtpu tenants` renders)
+        with self._producers_guard:
+            prods = [(n, entry[0].algorithm)
+                     for n, entry in self._producers.items()]
+        for name, algo in prods:
+            tenant = tenant_of.get(name, "default")
+            d = tenants.setdefault(tenant, {"experiments": 0, "evicted": 0})
+            tele = getattr(algo, "suggest_ahead_telemetry", None)
+            if tele is not None:
+                t = tele()
+                d["prefetch_hits"] = (
+                    d.get("prefetch_hits", 0) + t["prefetch_hits"])
+                d["prefetch_misses"] = (
+                    d.get("prefetch_misses", 0) + t["prefetch_misses"])
+            at = getattr(algo, "telemetry", None)
+            if at is not None:
+                t = at()
+                d["fused_commits"] = (
+                    d.get("fused_commits", 0) + t.get("fused_commits", 0))
+                d["fused_discards"] = (
+                    d.get("fused_discards", 0) + t.get("fused_discards", 0))
+        for d in tenants.values():
+            served = d.get("prefetch_hits", 0) + d.get("prefetch_misses", 0)
+            if served:
+                d["suggest_hit_rate"] = round(
+                    d.get("prefetch_hits", 0) / served, 4)
         out: Dict[str, Any] = {
             "tenants": tenants,
             "resident": max(0, len(tenant_of) - len(evicted)),
@@ -1614,6 +1680,8 @@ class CoordServer:
             "evictions": evictions,
             "hydrations": hydrations,
         }
+        if self._fuser is not None:
+            out["fuser"] = self._fuser.telemetry()
         if a.get("include_experiments"):
             per: Dict[str, Any] = {}
             for name, tenant in tenant_of.items():
@@ -1786,7 +1854,11 @@ class CoordServer:
 
                 if self.ledger.load_experiment(name) is None:
                     raise KeyError(f"experiment {name!r} not found")
-                exp = Experiment(name, ledger=self.ledger).configure()
+                # _producers_guard -> EXP is the canonical order
+                # (delete_experiment pops producers OUTSIDE the ledger
+                # locks for this reason); the reverse edge closing the
+                # cycle is the phantom mutating-dispatch edge above
+                exp = Experiment(name, ledger=self.ledger).configure()  # mtpu: lint-ok MTL001 canonical guard->EXP order; reverse edge is phantom
                 algo = make_algorithm(exp.space, exp.algorithm)
                 if (self.suggest_prefetch_depth > 1
                         and hasattr(algo, "suggest_prefetch_depth")):
@@ -2464,7 +2536,11 @@ class CoordServer:
                         return cached
                 try:
                     self._tl.reply_journaled = req is not None
-                    reply = {"ok": True, "result": self._dispatch(op, a)}
+                    # the EXP -> _producers_guard edge the call graph sees
+                    # here is phantom: this branch only dispatches
+                    # _MUTATING_OPS, and the guard-taking read ops
+                    # (tenant_stats) are dispatched lock-free below
+                    reply = {"ok": True, "result": self._dispatch(op, a)}  # mtpu: lint-ok MTL001 mutating-ops-only dispatch never reaches tenant_stats
                 except Exception as e:  # marshal, don't crash the service
                     reply = {"ok": False, "error": type(e).__name__,
                              "msg": str(e)}

@@ -790,6 +790,8 @@ class ShardSupervisor:
         max_experiments: Optional[int] = None,
         max_experiments_per_tenant: Optional[int] = None,
         tenant_weights: Optional[Dict[str, float]] = None,
+        fuse_suggest: bool = False,
+        fuse_bucket_max: Optional[int] = None,
     ) -> None:
         if n_shards < 1:
             raise ValueError("need at least one shard")
@@ -810,6 +812,10 @@ class ShardSupervisor:
         self.max_experiments = max_experiments
         self.max_experiments_per_tenant = max_experiments_per_tenant
         self.tenant_weights = tenant_weights
+        # fused suggest plane: forwarded to every shard (each shard fuses
+        # across ITS resident experiments — buckets never span shards)
+        self.fuse_suggest = fuse_suggest
+        self.fuse_bucket_max = fuse_bucket_max
         self.vnodes = vnodes
         self.ready_timeout_s = ready_timeout_s
         self._want_router = router
@@ -979,6 +985,10 @@ class ShardSupervisor:
             argv += ["--tenant-weights",
                      json.dumps(self.tenant_weights,
                                 separators=(",", ":"))]
+        if self.fuse_suggest:
+            argv += ["--fuse-suggest"]
+        if self.fuse_bucket_max is not None:
+            argv += ["--fuse-bucket-max", str(self.fuse_bucket_max)]
         return argv
 
     def _spawn(self, i: int, env_extra: Optional[Dict[str, str]] = None,
@@ -1165,6 +1175,8 @@ def _shard_main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--max-resident", type=int, default=None)
     ap.add_argument("--max-experiments", type=int, default=None)
     ap.add_argument("--max-experiments-per-tenant", type=int, default=None)
+    ap.add_argument("--fuse-suggest", action="store_true", default=False)
+    ap.add_argument("--fuse-bucket-max", type=int, default=None)
     ap.add_argument("--tenant-weights", default=None,
                     help="tenant→weight map as inline JSON")
     a = ap.parse_args(argv)
@@ -1184,6 +1196,10 @@ def _shard_main(argv: Optional[List[str]] = None) -> None:
         extra["max_experiments_per_tenant"] = a.max_experiments_per_tenant
     if a.tenant_weights:
         extra["tenant_weights"] = json.loads(a.tenant_weights)
+    if a.fuse_suggest:
+        extra["fuse_suggest"] = True
+    if a.fuse_bucket_max is not None:
+        extra["fuse_bucket_max"] = a.fuse_bucket_max
     serve_forever(CoordServer(
         host=a.host,
         port=a.port,

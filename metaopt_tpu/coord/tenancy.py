@@ -114,6 +114,26 @@ class FairProduceScheduler:
         self.total_granted[tenant] = self.total_granted.get(tenant, 0) + 1
         return True
 
+    def grant_order(self, tenants: Iterable[str]) -> Dict[str, float]:
+        """Tenant → priority for the fused suggest plane's demand sweep.
+
+        The :class:`~metaopt_tpu.coord.fuser.SuggestFuser` collects
+        pending demand across ALL resident experiments each tick; it
+        does not consume produce grants (fused refills are speculative
+        background work, not reply-path capacity), but it ORDERS its
+        sweep by each tenant's unmet share — weight divided by grants
+        already held this window — so when a tick's bucket budget runs
+        out, the tenants the produce plane has served least keep their
+        prefetch pools warm first. Pure read: no window roll, no
+        accounting mutation. Serialized under ``_tenant_lock`` like
+        every other entry point.
+        """
+        out: Dict[str, float] = {}
+        for t in tenants:
+            held = self._granted.get(t, 0)
+            out[t] = self.weight(t) / (1.0 + held)
+        return out
+
     def stats(self) -> Dict[str, Dict[str, float]]:
         """Per-tenant lifetime accounting (``tenant_stats`` reply body)."""
         out: Dict[str, Dict[str, float]] = {}

@@ -193,6 +193,28 @@ def _fit_set_device(X, w_sel, count, prior_weight):
     return mu, sigma, logw
 
 
+def _categorical_cdf(key, logits, shape):
+    """Categorical draws: ONE uniform per slot + an inverse-CDF sweep.
+
+    Drop-in for ``jax.random.categorical(key, logits, shape=shape)`` on
+    the suggest hot path (same distribution, different bit mapping —
+    the Gumbel-max trick burns K gumbels PER draw, two transcendentals
+    each, which profiled as the single largest cost of a suggest launch
+    on CPU: ~90 us/experiment of a ~250 us body). Here the CDF costs one
+    softmax+cumsum over the logits (constant in the draw count) and each
+    draw is one uniform plus K compares.
+
+    Selection is "first k with cdf[k] >= u": a zero-probability category
+    (-inf logit) has cdf[k] == cdf[k-1] and can never satisfy
+    cdf[k] >= u > cdf[k-1], so dead/padded components are never drawn
+    (the clamp only guards the u ~ 1.0 rounding edge).
+    """
+    cdf = jnp.cumsum(jax.nn.softmax(logits, axis=-1), axis=-1)
+    u = jax.random.uniform(key, shape, dtype=cdf.dtype)
+    draw = jnp.sum(u[..., None] > cdf, axis=-1)
+    return jnp.minimum(draw, logits.shape[-1] - 1).astype(jnp.int32)
+
+
 def _cat_tables_device(X, w_sel, n_choices, prior_weight, kmax: int):
     """Re-weighted category frequency tables, (d, kmax) log-probs."""
     npad, d = X.shape
@@ -210,14 +232,7 @@ def _cat_tables_device(X, w_sel, n_choices, prior_weight, kmax: int):
     return jnp.log(jnp.clip(probs, 1e-12, None))
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "n_cand", "n_out", "kmax", "equal_weight",
-        "n_good_pad", "n_bad_pad", "n_pools",
-    ),
-)
-def tpe_suggest_fused(
+def _tpe_suggest_body(
     X,                   # (N, d) unit-cube observations, padded (N ≥ n+1)
     y,                   # (N,) objectives, +inf padding
     n,                   # scalar int32: live observation count
@@ -309,7 +324,7 @@ def tpe_suggest_fused(
         key = jax.random.fold_in(base_key, count + p)
         k_comp, k_draw, k_redraw, k_cat = jax.random.split(key, 4)
 
-        comp = jax.random.categorical(k_comp, g_logw.T, shape=(C, d))
+        comp = _categorical_cdf(k_comp, g_logw.T, (C, d))
         mu_c = g_mu[comp, dim_idx]
         sig_c = g_sig[comp, dim_idx]
         draws = mu_c + sig_c * jax.random.normal(k_draw, (C, d))
@@ -317,7 +332,7 @@ def tpe_suggest_fused(
         oob = (draws < 0.0) | (draws > 1.0)
         draws = jnp.clip(jnp.where(oob, redraw, draws), 1e-6, 1.0 - 1e-6)
 
-        cats = jax.random.categorical(k_cat, cat_logits, shape=(C, d))
+        cats = _categorical_cdf(k_cat, cat_logits, (C, d))
         cat_vals = (cats.astype(jnp.float32) + 0.5) / k[None, :]
 
         cand = jnp.where(cont_mask[None, :], draws, cat_vals)    # (C, d)
@@ -337,6 +352,92 @@ def tpe_suggest_fused(
             cand.reshape(n_out, n_cand, d)[jnp.arange(n_out), winners]
         )
     return outs[0] if n_pools == 1 else jnp.concatenate(outs, axis=0)
+
+
+#: the per-experiment entry point: ONE experiment, one jitted program.
+#: The traced pipeline lives in ``_tpe_suggest_body`` so the fleet kernel
+#: below vmaps the IDENTICAL computation — bit-identity of fused vs
+#: per-experiment suggestions reduces to "same body, same inputs".
+tpe_suggest_fused = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_cand", "n_out", "kmax", "equal_weight",
+        "n_good_pad", "n_bad_pad", "n_pools",
+    ),
+)(_tpe_suggest_body)
+
+
+def _stk(col):
+    """Column-stack a fleet input inside the trace.
+
+    Each column arrives either already stacked (a (B, ...) array — the
+    test-friendly form) or as a TUPLE of B per-experiment leaves — the
+    bucket-native form the fuser passes. Tuples are stacked HERE, inside
+    the jitted program: the stack compiles into the launch (one dispatch
+    for the whole bucket instead of ~2 dispatched host ops per column
+    per member, which measured 14 ms of a 32 ms sweep at B=16), and
+    device-resident buffers are stacked device-side, never touching the
+    host. The tuple length is part of the jit cache key, which is fine:
+    it equals the pow2-padded bucket size the static key already pins.
+    """
+    return jnp.stack(col) if isinstance(col, (tuple, list)) else col
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_cand", "n_out", "kmax", "equal_weight",
+        "n_good_pad", "n_bad_pad", "n_pools",
+    ),
+)
+def tpe_suggest_fleet(
+    X,                   # (B, N, d) stacked — or a B-tuple of (N, d)
+    y,                   # (B, N) objectives, +inf padding
+    n,                   # (B,) int32 live counts (may differ within a pad)
+    count,               # (B,) int32 PRNG stream positions
+    base_key,            # (B, key) per-experiment base keys
+    n_choices,           # (B, d) int32
+    cont_mask,           # (B, d) bool
+    gamma,               # (B,) float32
+    prior_weight,        # (B,) float32
+    full_weight_num,     # (B,) float32
+    n_prior,             # (B,) int32
+    transfer_discount,   # (B,) float32
+    *,
+    n_cand: int,
+    n_out: int,
+    kmax: int,
+    equal_weight: bool,
+    n_good_pad: int = 0,
+    n_bad_pad: int = 0,
+    n_pools: int = 1,
+):
+    """``tpe_suggest_fused`` for a BUCKET of experiments in ONE launch.
+
+    vmaps ``_tpe_suggest_body`` over a leading experiment axis: every
+    per-experiment quantity (buffer, live count, stream position, space
+    encoding, hyperparameters) is stacked and traced, while the bucket
+    key's statics (pads, candidate/pool widths, kmax, equal_weight) are
+    uniform across members — that is exactly what makes two experiments
+    bucket-compatible (coord/fuser.py). Every column accepts either the
+    stacked (B, ...) array or a B-tuple of per-experiment leaves, which
+    is stacked in-trace (see ``_stk``). Row b of the result is bitwise
+    the array ``tpe_suggest_fused`` would return for experiment b alone:
+    the body is the same traced code, reductions keep their per-row
+    order under the batch dim, and the PRNG is counter-based per
+    experiment (fold_in of b's own key — nothing crosses the stack
+    axis). Returns (B, n_pools * n_out, d).
+    """
+    body = functools.partial(
+        _tpe_suggest_body,
+        n_cand=n_cand, n_out=n_out, kmax=kmax, equal_weight=equal_weight,
+        n_good_pad=n_good_pad, n_bad_pad=n_bad_pad, n_pools=n_pools,
+    )
+    return jax.vmap(body)(
+        _stk(X), _stk(y), _stk(n), _stk(count), _stk(base_key),
+        _stk(n_choices), _stk(cont_mask), _stk(gamma), _stk(prior_weight),
+        _stk(full_weight_num), _stk(n_prior), _stk(transfer_discount),
+    )
 
 
 def split_pads(n: int, gamma: float) -> tuple:

@@ -116,9 +116,11 @@ def test_kill9_at_barrier_zero_acked_loss(tmp_path, kind, skip, victim_idx):
     workers = 4
     nm = _exp_owned_by("s0")  # source is always shard 0, dest shard 1
     env = {victim_idx: {"METAOPT_TPU_FAULTS": f"{kind}:1@{skip}"}}
+    # fused suggest plane on: the per-shard demand sweep must ride
+    # through the migration fence and the SIGKILL barriers untouched
     with ShardSupervisor(2, snapshot_dir=str(tmp_path),
                          snapshot_interval_s=0.5, restart=True,
-                         shard_env=env) as sup:
+                         shard_env=env, fuse_suggest=True) as sup:
         host, port = sup.address
         client = CoordLedgerClient(host=host, port=port,
                                    reconnect_window_s=30.0)
@@ -183,7 +185,7 @@ def test_failover_drill_survivors_absorb_dead_shard(tmp_path):
     survivor_exp = _exp_owned_by("s1", prefix="chaos-failover")
     with ShardSupervisor(2, snapshot_dir=str(tmp_path),
                          snapshot_interval_s=0.5, restart=True,
-                         failover=True) as sup:
+                         failover=True, fuse_suggest=True) as sup:
         host, port = sup.address
         client = CoordLedgerClient(host=host, port=port,
                                    reconnect_window_s=30.0)

@@ -343,13 +343,17 @@ def test_transfer_priors_evc_resolves_the_branch_chain():
 # -- kill -9 chaos at the eviction durability barriers --------------------
 
 # eviction-enabled subprocess server: idle experiments evict after 2 s,
-# which is where the armed crash_evict barrier fires
+# which is where the armed crash_evict barrier fires. The fused suggest
+# plane rides along (its demand sweep must coexist with eviction
+# teardown and the SIGKILL barriers without perturbing the crash
+# matrix — the acceptance bar for `--fuse-suggest`)
 _SERVER_SRC = """
 import sys
 from metaopt_tpu.coord.server import CoordServer, serve_forever
 serve_forever(CoordServer(
     port=int(sys.argv[1]), snapshot_path=sys.argv[2], stale_timeout_s=60.0,
     evict_idle_s=2.0, sweep_interval_s=0.1,
+    fuse_suggest=True, fuse_interval_s=0.05,
 ))
 """
 
